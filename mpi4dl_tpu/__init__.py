@@ -1,0 +1,39 @@
+"""mpi4dl_tpu — a TPU-native framework for hybrid five-dimensional parallel
+training of CNNs on very-high-resolution images.
+
+Re-designed from scratch for TPU (JAX / XLA / pjit / shard_map / Pallas) with the
+capabilities of OSU-Nowlab/MPI4DL (reference survey in SURVEY.md):
+
+- **DP**    data parallelism over a ``data`` mesh axis (``psum`` gradients).
+- **LP/PP** layer + GPipe pipeline parallelism over a ``stage`` mesh axis: one
+  SPMD program where each device runs its stage via ``lax.switch`` on flat,
+  stage-sharded parameter buffers and hands activations to its neighbour with
+  ``lax.ppermute`` (reference: src/torchgems/mp_pipeline.py — tagged MPI
+  send/recv between per-rank processes).
+- **SP**    spatial parallelism: image H/W sharded over ``sph``/``spw`` mesh
+  axes, halo (ghost-region) exchange expressed as non-wrapping ``ppermute``
+  (reference: src/torchgems/spatial.py — 9-neighbour MPI isend/irecv).
+- **GEMS**  bidirectional memory-aware model parallelism: a second activation
+  stream flowing through the stage chain in the opposite direction inside the
+  same compiled step (reference: src/torchgems/gems_master.py).
+
+Unlike the reference there are no ranks, tags, recv buffers, or stream/MPI race
+workarounds: everything is a single jitted dataflow program per step, and XLA
+orders the collectives.
+"""
+
+__version__ = "0.1.0"
+
+from mpi4dl_tpu.config import ParallelConfig, get_parser, config_from_args
+from mpi4dl_tpu.mesh import build_mesh, MeshSpec
+from mpi4dl_tpu.layer_ctx import ApplyCtx, SpatialCtx
+
+__all__ = [
+    "ParallelConfig",
+    "get_parser",
+    "config_from_args",
+    "build_mesh",
+    "MeshSpec",
+    "ApplyCtx",
+    "SpatialCtx",
+]

@@ -1,0 +1,153 @@
+"""Cells: the unit of layer-parallel splitting.
+
+The reference splits a top-level ``nn.Sequential`` of coarse "cells" by index
+range (``src/torchgems/mp_pipeline.py:41-83``) and discovers inter-split
+shapes by a two-phase dummy forward (``:126-168``).  Here a model *is* a list
+of :class:`Cell` objects; shapes come from ``jax.eval_shape`` over the global
+(unsharded) shapes — no probe forward, no `image_size_seq` rescaling
+(reference benchmark_amoebanet_sp.py:120-125 exists only because probing at
+full resolution OOMs; eval_shape is abstract so it cannot).
+
+A cell's activation may be a single array or a tuple of arrays — AmoebaNet
+cells carry ``(x, skip)`` tuple state (reference amoebanet.py:500-532,
+the reason the reference pipeline supports MULTIPLE_INPUT/OUTPUT).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from mpi4dl_tpu.layer_ctx import ApplyCtx, EVAL_CTX
+from mpi4dl_tpu.layers import Layer
+
+Act = Union[jax.Array, Tuple[jax.Array, ...]]
+ShapeLike = Union[Tuple[int, ...], Tuple[Tuple[int, ...], ...]]
+
+
+class Cell:
+    """One pipeline-splittable unit: init/apply plus a human name."""
+
+    name: str = "cell"
+
+    def init(self, key, in_shape: ShapeLike):
+        raise NotImplementedError
+
+    def apply(self, params, x: Act, ctx: ApplyCtx) -> Act:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class LayerCell(Cell):
+    """A cell made of a plain sequence of layers (single-tensor state)."""
+
+    layers: Sequence[Layer]
+    name: str = "seq"
+
+    def init(self, key, in_shape):
+        keys = jax.random.split(key, max(len(self.layers), 1))
+        params = []
+        shape = in_shape
+        for k, layer in zip(keys, self.layers):
+            p, shape = layer.init(k, shape)
+            params.append(p)
+        return params, shape
+
+    def apply(self, params, x, ctx):
+        for p, layer in zip(params, self.layers):
+            x = layer.apply(p, x, ctx)
+        return x
+
+
+@dataclasses.dataclass
+class FnCell(Cell):
+    """A cell defined by explicit init/apply callables (for residual blocks,
+    NAS cells, heads...)."""
+
+    init_fn: Callable[[Any, ShapeLike], Tuple[Any, ShapeLike]]
+    apply_fn: Callable[[Any, Act, ApplyCtx], Act]
+    name: str = "fn"
+
+    def init(self, key, in_shape):
+        return self.init_fn(key, in_shape)
+
+    def apply(self, params, x, ctx):
+        return self.apply_fn(params, x, ctx)
+
+
+@dataclasses.dataclass
+class CellModel:
+    """A model: ordered cells + metadata.
+
+    ``spatial_until``: number of leading cells that run under spatial sharding
+    (the analog of the reference's `spatial_size` splits running conv_spatial;
+    the junction gather happens after cell index spatial_until-1).
+    """
+
+    cells: List[Cell]
+    in_shape: Tuple[int, ...]
+    num_classes: int
+    spatial_until: int = 0
+    name: str = "model"
+
+    def init(self, key) -> Tuple[List[Any], List[ShapeLike]]:
+        """Init all cells; returns (params_list, shape_list) where
+        shape_list[i] is the *output* shape of cell i (global shapes).
+        shape_list mirrors the reference's get_output_shapes result
+        (mp_pipeline.py:126-168)."""
+        keys = jax.random.split(key, len(self.cells))
+        params_list, shapes = [], []
+        shape: ShapeLike = self.in_shape
+        for k, cell in zip(keys, self.cells):
+            p, shape = cell.init(k, shape)
+            params_list.append(p)
+            shapes.append(shape)
+        return params_list, shapes
+
+    def apply(self, params_list, x: Act, ctx: ApplyCtx, *,
+              start: int = 0, stop: Optional[int] = None) -> Act:
+        """Run cells [start, stop) — the per-stage sub-model."""
+        stop = len(self.cells) if stop is None else stop
+        for i in range(start, stop):
+            x = self.cells[i].apply(params_list[i], x, ctx)
+        return x
+
+    def out_shapes(self, params_list) -> List[ShapeLike]:
+        """Abstract shape inference via eval_shape (no FLOPs, no memory)."""
+        shapes: List[ShapeLike] = []
+        x = jax.ShapeDtypeStruct(self.in_shape, jnp.float32)
+        for cell, p in zip(self.cells, params_list):
+            x = jax.eval_shape(lambda p, x, c=cell: c.apply(p, x, EVAL_CTX), p, x)
+            shapes.append(
+                tuple(t.shape for t in x) if isinstance(x, tuple) else x.shape
+            )
+        return shapes
+
+
+def split_even(n_cells: int, split_size: int, balance: Optional[Sequence[int]] = None
+               ) -> List[Tuple[int, int]]:
+    """Partition cell indices into `split_size` contiguous ranges.
+
+    Even split puts the remainder on the earliest stages, matching the
+    reference's get_start_end_layer_index (mp_pipeline.py:41-69); an explicit
+    `balance` list of per-stage cell counts overrides (must sum to n_cells,
+    reference asserts mp_pipeline.py:55-58).
+    """
+    if balance is not None:
+        assert sum(balance) == n_cells, (balance, n_cells)
+        out, start = [], 0
+        for b in balance:
+            out.append((start, start + b))
+            start += b
+        return out
+    base = n_cells // split_size
+    rem = n_cells % split_size
+    out, start = [], 0
+    for s in range(split_size):
+        size = base + (1 if s < rem else 0)
+        out.append((start, start + size))
+        start += size
+    return out

@@ -1,0 +1,165 @@
+"""Configuration / flags.
+
+Mirrors the reference's single shared argparse parser
+(``src/torchgems/parser.py:21-143``) so users of the reference find the same
+vocabulary, plus TPU-specific knobs (mesh shape, dtype, D2 fusion, BN scope).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class ParallelConfig:
+    # --- model / problem (reference parser.py) ---
+    model: str = "resnet"  # resnet | amoebanet
+    batch_size: int = 32
+    parts: int = 1  # micro-batches per step (GPipe "parts")
+    split_size: int = 1  # number of pipeline stages (LP splits)
+    num_spatial_parts: Tuple[int, ...] = (4,)  # comma-list in the reference
+    spatial_size: int = 1  # how many leading splits are spatial
+    times: int = 1  # GEMS replication factor ("--times")
+    image_size: int = 32
+    num_epochs: int = 1
+    num_layers: int = 18  # amoebanet cell count knob
+    num_filters: int = 416
+    num_classes: int = 10
+    balance: Optional[Tuple[int, ...]] = None  # per-stage cell counts
+    halo_d2: bool = False  # fused-halo "design 2"
+    fused_layers: int = 1  # convs per fused halo block in D2
+    local_dp_lp: int = 1  # LOCAL_DP_LP: DP degree inside LP stages
+    slice_method: str = "square"  # square | vertical | horizontal
+    app: int = 3  # 1=image folder, 2=cifar-like, 3=synthetic (reference APP)
+    datapath: str = "./train"
+    enable_master_comm_opt: bool = False  # GEMS MASTER-OPT analog
+    num_workers: int = 0
+    precision: str = "fp_32"  # fp_32 | bf_16 | bf_16_all (reference vocabulary)
+
+    # --- TPU-native knobs (new) ---
+    data_parallel: int = 1  # outer DP degree
+    bn_cross_tile: bool = True  # BN stats across spatial tiles (fix) or per-tile (parity)
+    softmax_in_model: bool = False  # reproduce reference double-softmax quirk
+    enable_gems: bool = False
+    lr: float = 0.001  # reference benchmarks use SGD(lr=0.001)
+    momentum: float = 0.0
+    optimizer: str = "sgd"
+    remat: bool = True  # jax.checkpoint each stage application
+    checkpoint_dir: Optional[str] = None
+    seed: int = 0
+
+    @property
+    def spatial_part_size(self) -> int:
+        return self.num_spatial_parts[0]
+
+    @property
+    def compute_dtype(self):
+        import jax.numpy as jnp
+
+        return jnp.bfloat16 if self.precision in ("bf_16", "bf_16_all") else jnp.float32
+
+    @property
+    def param_dtype(self):
+        import jax.numpy as jnp
+
+        return jnp.bfloat16 if self.precision == "bf_16_all" else jnp.float32
+
+    def validate(self) -> None:
+        from mpi4dl_tpu.utils import is_power_two
+
+        # Reference verify_spatial_config (train_spatial.py:33-58): power-of-2
+        # image size and per-tile sizes, legal slice method.
+        assert self.slice_method in ("square", "vertical", "horizontal")
+        if self.spatial_size > 0 and self.spatial_part_size > 1:
+            assert is_power_two(self.image_size), "image_size must be a power of two"
+            assert self.image_size % self.spatial_part_size == 0
+        assert self.batch_size % self.parts == 0, "batch must divide into parts"
+        if self.balance is not None:
+            assert len(self.balance) == self.split_size
+
+
+def get_parser() -> argparse.ArgumentParser:
+    """Argparse mirroring reference parser.py flag names."""
+    p = argparse.ArgumentParser(description="mpi4dl_tpu benchmarks")
+    p.add_argument("--model", type=str, default="resnet")
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--parts", type=int, default=1)
+    p.add_argument("--split-size", type=int, default=1)
+    p.add_argument("--num-spatial-parts", type=str, default="4")
+    p.add_argument("--spatial-size", type=int, default=1)
+    p.add_argument("--times", type=int, default=1)
+    p.add_argument("--image-size", type=int, default=32)
+    p.add_argument("--num-epochs", type=int, default=1)
+    p.add_argument("--num-layers", type=int, default=18)
+    p.add_argument("--num-filters", type=int, default=416)
+    p.add_argument("--num-classes", type=int, default=10)
+    p.add_argument("--balance", type=str, default=None)
+    p.add_argument("--halo-d2", action="store_true")
+    p.add_argument("--fused-layers", type=int, default=1)
+    p.add_argument("--local-DP", dest="local_dp_lp", type=int, default=1)
+    p.add_argument(
+        "--slice-method",
+        type=str,
+        default="square",
+        help="square | vertical | horizontal",
+    )
+    p.add_argument("--app", type=int, default=3)
+    p.add_argument("--datapath", type=str, default="./train")
+    p.add_argument("--enable-master-comm-opt", action="store_true")
+    p.add_argument("--num-workers", type=int, default=0)
+    p.add_argument("--precision", type=str, default="fp_32")
+    # TPU-native additions
+    p.add_argument("--data-parallel", type=int, default=1)
+    p.add_argument("--per-tile-bn", action="store_true", help="reference-parity per-tile BN stats")
+    p.add_argument("--softmax-in-model", action="store_true")
+    p.add_argument("--enable-gems", action="store_true")
+    p.add_argument("--lr", type=float, default=0.001)
+    p.add_argument("--no-remat", action="store_true")
+    p.add_argument("--checkpoint-dir", type=str, default=None)
+    p.add_argument("--seed", type=int, default=0)
+    return p
+
+
+def _int_tuple(s: Optional[str]) -> Optional[Tuple[int, ...]]:
+    if s is None or s == "":
+        return None
+    return tuple(int(x) for x in s.split(","))
+
+
+def config_from_args(args: argparse.Namespace) -> ParallelConfig:
+    cfg = ParallelConfig(
+        model=args.model,
+        batch_size=args.batch_size,
+        parts=args.parts,
+        split_size=args.split_size,
+        num_spatial_parts=_int_tuple(args.num_spatial_parts) or (4,),
+        spatial_size=args.spatial_size,
+        times=args.times,
+        image_size=args.image_size,
+        num_epochs=args.num_epochs,
+        num_layers=args.num_layers,
+        num_filters=args.num_filters,
+        num_classes=args.num_classes,
+        balance=_int_tuple(args.balance),
+        halo_d2=args.halo_d2,
+        fused_layers=args.fused_layers,
+        local_dp_lp=args.local_dp_lp,
+        slice_method=args.slice_method,
+        app=args.app,
+        datapath=args.datapath,
+        enable_master_comm_opt=args.enable_master_comm_opt,
+        num_workers=args.num_workers,
+        precision=args.precision,
+        data_parallel=args.data_parallel,
+        bn_cross_tile=not args.per_tile_bn,
+        softmax_in_model=args.softmax_in_model,
+        enable_gems=args.enable_gems,
+        lr=args.lr,
+        remat=not args.no_remat,
+        checkpoint_dir=args.checkpoint_dir,
+        seed=args.seed,
+    )
+    cfg.validate()
+    return cfg

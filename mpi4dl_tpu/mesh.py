@@ -1,0 +1,88 @@
+"""Device-mesh construction.
+
+The reference derives a rank topology by hand from MPI world size
+(``src/torchgems/comm.py:44-137``: split_rank math, spatial groups, GEMS rank
+inversion).  On TPU all of that becomes a named :class:`jax.sharding.Mesh`:
+
+- ``data``  — outer data parallelism (reference allreduce groups)
+- ``stage`` — pipeline/layer-parallel stages (reference split_rank)
+- ``sph``/``spw`` — spatial tile grid over image H/W (reference spatial ranks)
+
+GEMS needs no axis: the mirror placement is a compile-time permutation of the
+``stage`` axis (see parallel/gems.py), not a second set of processes.
+
+Axis order is (data, stage, sph, spw) so that the *innermost* (fastest-moving,
+most-bandwidth-coupled on ICI) axes are the spatial tile axes that exchange
+halos every conv, and stage neighbours are contiguous blocks — the topological
+analog of the reference pinning spatial ranks to one node's 4 GPUs
+(``comm.py:34-41``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("data", "stage", "sph", "spw")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    data: int = 1
+    stage: int = 1
+    sph: int = 1
+    spw: int = 1
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return (self.data, self.stage, self.sph, self.spw)
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self.shape))
+
+    @classmethod
+    def from_config(cls, cfg) -> "MeshSpec":
+        """Derive the mesh from a ParallelConfig, mirroring the reference's
+        mp_size math (comm.py:59-67): the spatial region occupies
+        num_spatial_parts devices which double as the first `spatial_size`
+        pipeline stage(s)."""
+        if cfg.spatial_size > 0 and cfg.spatial_part_size > 1:
+            if cfg.slice_method == "square":
+                g = int(np.sqrt(cfg.spatial_part_size))
+                sph, spw = g, g
+            elif cfg.slice_method == "vertical":
+                sph, spw = 1, cfg.spatial_part_size
+            else:  # horizontal
+                sph, spw = cfg.spatial_part_size, 1
+        else:
+            sph, spw = 1, 1
+        return cls(data=cfg.data_parallel, stage=cfg.split_size, sph=sph, spw=spw)
+
+
+def build_mesh(
+    spec: MeshSpec,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a named Mesh of shape (data, stage, sph, spw).
+
+    With fewer physical devices than ``spec.size`` this raises — tests use the
+    8-device CPU fixture; the driver validates multi-chip via
+    ``__graft_entry__.dryrun_multichip``.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    need = spec.size
+    if len(devices) < need:
+        raise ValueError(
+            f"mesh {spec} needs {need} devices, have {len(devices)}"
+        )
+    arr = np.array(devices[:need]).reshape(spec.shape)
+    return Mesh(arr, AXES)
+
+
+def single_device_mesh() -> Mesh:
+    return build_mesh(MeshSpec())

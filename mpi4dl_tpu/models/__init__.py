@@ -1,0 +1,28 @@
+from mpi4dl_tpu.models.resnet import get_resnet_v1, get_resnet_v2, get_resnet
+from mpi4dl_tpu.models.amoebanet import amoebanetd
+
+__all__ = ["get_resnet_v1", "get_resnet_v2", "get_resnet", "amoebanetd"]
+
+
+def build_model(cfg):
+    """Build the model named by cfg.model at cfg's geometry (the dispatch each
+    reference benchmark script performs inline)."""
+    from mpi4dl_tpu.utils import get_depth
+
+    in_shape = (cfg.batch_size // cfg.parts, cfg.image_size, cfg.image_size, 3)
+    if cfg.model == "resnet":
+        return get_resnet(
+            in_shape,
+            depth=get_depth(2, 12),
+            num_classes=cfg.num_classes,
+            version=2,
+            softmax_in_model=cfg.softmax_in_model,
+        )
+    elif cfg.model == "amoebanet":
+        return amoebanetd(
+            in_shape,
+            num_classes=cfg.num_classes,
+            num_layers=cfg.num_layers,
+            num_filters=cfg.num_filters,
+        )
+    raise ValueError(f"unknown model {cfg.model!r}")

@@ -1,0 +1,329 @@
+"""AmoebaNet-D as a cell list.
+
+Topology per the reference (``src/models/amoebanet.py:449-615``, itself after
+the TensorFlow/GPipe AmoebaNet-D): a Stem, two reduction stem cells, three
+groups of normal cells separated by reduction cells, and a Classify head.
+Each NAS cell carries tuple state ``(x, skip)`` — the multi-tensor activation
+the pipeline engine must forward between stages (reference
+amoebanet.py:500-532; pipeline support mp_pipeline.py:215-223).
+
+Deliberate fix (SURVEY §7 bug list — not replicated): the reference's
+``max_pool_3x3`` constructs an **Avg**Pool in both branches
+(amoebanet.py:108-125); here it is a real max pool.
+
+As with ResNet, there is exactly one definition: the reference's separate
+``amoebanetd_spatial`` / ``amoebanet_d2`` variants collapse into apply-time
+ApplyCtx dispatch (halo-exchanging convs/pools under spatial sharding).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from mpi4dl_tpu.cells import Cell, CellModel, LayerCell
+from mpi4dl_tpu.layer_ctx import ApplyCtx
+from mpi4dl_tpu.layers import (
+    BatchNorm,
+    Conv2d,
+    Dense,
+    GlobalAvgPool,
+    Identity,
+    Layer,
+    Pool2d,
+    ReLU,
+)
+
+# ---------------------------------------------------------------------------
+# Op constructors (reference amoebanet.py:79-399).  Each returns a LayerCell
+# operating on a single tensor; channels is the cell's working width c.
+# ---------------------------------------------------------------------------
+
+
+def _relu_conv_bn(in_c: int, out_c: int, kernel=1, stride=1, padding=0) -> List[Layer]:
+    return [
+        ReLU(),
+        Conv2d(in_c, out_c, kernel_size=kernel, stride=stride, padding=padding, bias=False),
+        BatchNorm(out_c),
+    ]
+
+
+@dataclasses.dataclass
+class FactorizedReduce(Cell):
+    """relu → concat(conv1(x), conv2(x)) → bn, both 1x1 stride-2 halves
+    (reference amoebanet.py:56-76; the pixel-shifted second path is commented
+    out there, so both halves see the same input)."""
+
+    in_c: int
+    out_c: int
+    name: str = "fact_reduce"
+
+    def __post_init__(self):
+        self.conv1 = Conv2d(self.in_c, self.out_c // 2, kernel_size=1, stride=2,
+                            padding=0, bias=False)
+        self.conv2 = Conv2d(self.in_c, self.out_c // 2, kernel_size=1, stride=2,
+                            padding=0, bias=False)
+        self.bn = BatchNorm(self.out_c)
+
+    def init(self, key, in_shape):
+        k1, k2, k3 = jax.random.split(key, 3)
+        p1, s1 = self.conv1.init(k1, in_shape)
+        p2, _ = self.conv2.init(k2, in_shape)
+        cat_shape = (*s1[:-1], self.out_c)
+        p3, out = self.bn.init(k3, cat_shape)
+        return {"conv1": p1, "conv2": p2, "bn": p3}, out
+
+    def apply(self, params, x, ctx):
+        x = jax.nn.relu(x)
+        y = jnp.concatenate(
+            [self.conv1.apply(params["conv1"], x, ctx),
+             self.conv2.apply(params["conv2"], x, ctx)],
+            axis=-1,
+        )
+        return self.bn.apply(params["bn"], y, ctx)
+
+
+def op_none(c: int, stride: int) -> Cell:
+    if stride == 1:
+        return LayerCell([Identity()], name="none")
+    return FactorizedReduce(c, c)
+
+
+def op_avg_pool_3x3(c: int, stride: int) -> Cell:
+    return LayerCell(
+        [Pool2d("avg", 3, stride, 1, count_include_pad=False)], name="avg_pool_3x3"
+    )
+
+
+def op_max_pool_3x3(c: int, stride: int) -> Cell:
+    return LayerCell([Pool2d("max", 3, stride, 1)], name="max_pool_3x3")
+
+
+def op_max_pool_2x2(c: int, stride: int) -> Cell:
+    return LayerCell([Pool2d("max", 2, stride, 0)], name="max_pool_2x2")
+
+
+def op_conv_1x1(c: int, stride: int) -> Cell:
+    return LayerCell(_relu_conv_bn(c, c, 1, stride, 0), name="conv_1x1")
+
+
+def op_conv_3x3(c: int, stride: int) -> Cell:
+    # Bottleneck form c → c/4 → c (reference amoebanet.py:252-287)
+    return LayerCell(
+        _relu_conv_bn(c, c // 4, 1, 1, 0)
+        + _relu_conv_bn(c // 4, c // 4, 3, stride, 1)
+        + _relu_conv_bn(c // 4, c, 1, 1, 0),
+        name="conv_3x3",
+    )
+
+
+def op_conv_1x7_7x1(c: int, stride: int) -> Cell:
+    # c → c/4 → (1,7) → (7,1) → c with stride applied once per image dim
+    # (reference amoebanet.py:147-243)
+    return LayerCell(
+        _relu_conv_bn(c, c // 4, 1, 1, 0)
+        + _relu_conv_bn(c // 4, c // 4, (1, 7), (1, stride), (0, 3))
+        + _relu_conv_bn(c // 4, c // 4, (7, 1), (stride, 1), (3, 0))
+        + _relu_conv_bn(c // 4, c, 1, 1, 0),
+        name="conv_1x7_7x1",
+    )
+
+
+# Genotype (reference amoebanet.py:290-330): (input_index, op_ctor) pairs.
+NORMAL_OPERATIONS: List[Tuple[int, Callable[[int, int], Cell]]] = [
+    (1, op_conv_1x1),
+    (1, op_max_pool_3x3),
+    (1, op_none),
+    (0, op_conv_1x7_7x1),
+    (0, op_conv_1x1),
+    (0, op_conv_1x7_7x1),
+    (2, op_max_pool_3x3),
+    (2, op_none),
+    (1, op_avg_pool_3x3),
+    (5, op_conv_1x1),
+]
+NORMAL_CONCAT = [0, 3, 4, 6]
+
+REDUCTION_OPERATIONS: List[Tuple[int, Callable[[int, int], Cell]]] = [
+    (0, op_max_pool_2x2),
+    (0, op_max_pool_3x3),
+    (2, op_none),
+    (1, op_conv_3x3),
+    (2, op_conv_1x7_7x1),
+    (2, op_max_pool_3x3),
+    (3, op_none),
+    (1, op_max_pool_2x2),
+    (2, op_avg_pool_3x3),
+    (3, op_conv_1x1),
+]
+REDUCTION_CONCAT = [4, 5, 6]
+
+
+@dataclasses.dataclass
+class Stem(Cell):
+    """relu → conv3x3 s2 → bn (reference amoebanet.py:418-446; yes, the relu
+    on raw input is what the reference does)."""
+
+    channels: int
+    name: str = "stem"
+
+    def __post_init__(self):
+        self.conv = Conv2d(3, self.channels, 3, stride=2, padding=1, bias=False)
+        self.bn = BatchNorm(self.channels)
+
+    def init(self, key, in_shape):
+        k1, k2 = jax.random.split(key)
+        p1, s = self.conv.init(k1, in_shape)
+        p2, s = self.bn.init(k2, s)
+        return {"conv": p1, "bn": p2}, s
+
+    def apply(self, params, x, ctx):
+        x = jax.nn.relu(x)
+        x = self.conv.apply(params["conv"], x, ctx)
+        return self.bn.apply(params["bn"], x, ctx)
+
+
+@dataclasses.dataclass
+class AmoebaCell(Cell):
+    """One NAS cell.  State in/out is (x, skip); a lone tensor is broadcast to
+    both (reference Cell.forward, amoebanet.py:500-532)."""
+
+    channels_prev_prev: int
+    channels_prev: int
+    channels: int
+    reduction: bool
+    reduction_prev: bool
+    name: str = "amoeba_cell"
+
+    def __post_init__(self):
+        c = self.channels
+        self.reduce1 = LayerCell(_relu_conv_bn(self.channels_prev, c), name="reduce1")
+        if self.reduction_prev:
+            self.reduce2: Cell = FactorizedReduce(self.channels_prev_prev, c)
+        elif self.channels_prev_prev != c:
+            self.reduce2 = LayerCell(_relu_conv_bn(self.channels_prev_prev, c), name="reduce2")
+        else:
+            self.reduce2 = LayerCell([Identity()], name="reduce2_id")
+        ops_spec = REDUCTION_OPERATIONS if self.reduction else NORMAL_OPERATIONS
+        self.concat = REDUCTION_CONCAT if self.reduction else NORMAL_CONCAT
+        self.indices = [i for i, _ in ops_spec]
+        self.ops: List[Cell] = []
+        for i, ctor in ops_spec:
+            stride = 2 if (self.reduction and i < 2) else 1
+            self.ops.append(ctor(c, stride))
+
+    def init(self, key, in_shape):
+        # in_shape: (shape_x, shape_skip) or a single shape used for both.
+        if isinstance(in_shape[0], (tuple, list)):
+            s1_shape, s2_shape = in_shape
+        else:
+            s1_shape = s2_shape = in_shape
+        keys = jax.random.split(key, 2 + len(self.ops))
+        p_r1, s1 = self.reduce1.init(keys[0], s1_shape)
+        p_r2, s2 = self.reduce2.init(keys[1], s2_shape)
+        state_shapes = [s1, s2]
+        op_params = []
+        for j in range(0, len(self.ops), 2):
+            in1 = state_shapes[self.indices[j]]
+            in2 = state_shapes[self.indices[j + 1]]
+            p1, o1 = self.ops[j].init(keys[2 + j], in1)
+            p2, o2 = self.ops[j + 1].init(keys[2 + j + 1], in2)
+            assert o1 == o2, (self.name, j, o1, o2)
+            op_params += [p1, p2]
+            state_shapes.append(o1)
+        out_c = self.channels * len(self.concat)
+        out_shape = (*state_shapes[self.concat[0]][:-1], out_c)
+        return {"reduce1": p_r1, "reduce2": p_r2, "ops": op_params}, (
+            out_shape,
+            s1_shape,
+        )
+
+    def apply(self, params, x, ctx: ApplyCtx):
+        if isinstance(x, tuple):
+            s1, s2 = x
+        else:
+            s1 = s2 = x
+        skip = s1
+        s1 = self.reduce1.apply(params["reduce1"], s1, ctx)
+        s2 = self.reduce2.apply(params["reduce2"], s2, ctx)
+        states = [s1, s2]
+        for j in range(0, len(self.ops), 2):
+            h1 = self.ops[j].apply(params["ops"][j], states[self.indices[j]], ctx)
+            h2 = self.ops[j + 1].apply(params["ops"][j + 1], states[self.indices[j + 1]], ctx)
+            states.append(h1 + h2)
+        out = jnp.concatenate([states[i] for i in self.concat], axis=-1)
+        return (out, skip)
+
+
+@dataclasses.dataclass
+class Classify(Cell):
+    """(x, skip) → global avg pool → FC (reference amoebanet.py:401-417)."""
+
+    channels_prev: int
+    num_classes: int
+    name: str = "classify"
+
+    def __post_init__(self):
+        self.pool = GlobalAvgPool()
+        self.fc = Dense(self.channels_prev, self.num_classes)
+
+    def init(self, key, in_shape):
+        x_shape = in_shape[0] if isinstance(in_shape[0], (tuple, list)) else in_shape
+        p_pool, s = self.pool.init(key, x_shape)
+        k1, _ = jax.random.split(key)
+        p_fc, out = self.fc.init(k1, s)
+        return {"fc": p_fc}, out
+
+    def apply(self, params, x, ctx):
+        if isinstance(x, tuple):
+            x = x[0]
+        y = self.pool.apply({}, x, ctx)
+        return self.fc.apply(params["fc"], y, ctx)
+
+
+def amoebanetd(
+    in_shape: Tuple[int, int, int, int],
+    num_classes: int = 10,
+    num_layers: int = 4,
+    num_filters: int = 512,
+) -> CellModel:
+    """Build AmoebaNet-D (reference amoebanetd(), amoebanet.py:535-615)."""
+    assert num_layers % 3 == 0, "num_layers must be divisible by 3"
+    repeat_normal = num_layers // 3
+
+    channels = num_filters // 4
+    channels_prev_prev = channels_prev = channels
+    reduction_prev = False
+    cells: List[Cell] = []
+
+    def add_cell(reduction: bool, scale: int, name: str):
+        nonlocal channels, channels_prev, channels_prev_prev, reduction_prev
+        channels *= scale
+        cell = AmoebaCell(
+            channels_prev_prev, channels_prev, channels, reduction, reduction_prev,
+            name=name,
+        )
+        cells.append(cell)
+        channels_prev_prev = channels_prev
+        channels_prev = channels * len(cell.concat)
+        reduction_prev = reduction
+
+    cells.append(Stem(channels))
+    add_cell(True, 2, "stem2")
+    add_cell(True, 2, "stem3")
+    for i in range(repeat_normal):
+        add_cell(False, 1, f"cell1_normal{i+1}")
+    add_cell(True, 2, "cell2_reduction")
+    for i in range(repeat_normal):
+        add_cell(False, 1, f"cell3_normal{i+1}")
+    add_cell(True, 2, "cell4_reduction")
+    for i in range(repeat_normal):
+        add_cell(False, 1, f"cell5_normal{i+1}")
+    cells.append(Classify(channels_prev, num_classes))
+
+    return CellModel(
+        cells, in_shape, num_classes, name=f"amoebanetd_l{num_layers}_f{num_filters}"
+    )

@@ -1,0 +1,258 @@
+"""ResNet v1 (6n+2) and v2 bottleneck (9n+2) as cell lists.
+
+Same topology as the reference builders (``src/models/resnet.py:145-178``
+v1, ``:270-323`` v2): a flat sequence of coarse cells — the unit the layer
+splitter partitions — ending in an avg-pool + FC head.  One definition serves
+sequential and spatial execution (the reference maintains three copies:
+resnet.py / resnet_spatial.py / resnet_spatial_d2.py); spatial behaviour is
+chosen by the ApplyCtx at apply time.
+
+Head deviation (flagged): the reference applies ``F.softmax`` inside the model
+*and* later CrossEntropyLoss — a double-softmax quirk (reference resnet.py:140,
+mp_pipeline.py:226).  Default here is logits out / softmax-cross-entropy in the
+loss; set ``softmax_in_model=True`` for bit-parity behaviour.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from mpi4dl_tpu.cells import Cell, CellModel, LayerCell
+from mpi4dl_tpu.layer_ctx import ApplyCtx
+from mpi4dl_tpu.layers import (
+    BatchNorm,
+    Conv2d,
+    Dense,
+    Flatten,
+    Layer,
+    Pool2d,
+    ReLU,
+    Softmax,
+)
+
+
+def _resnet_layer(
+    in_f: int,
+    out_f: int,
+    kernel: int = 3,
+    stride: int = 1,
+    activation: bool = True,
+    batch_norm: bool = True,
+    conv_first: bool = True,
+) -> List[Layer]:
+    """conv-bn-act (conv_first) or bn-act-conv (pre-activation), the
+    reference's resnet_layer building block (resnet.py:24-77)."""
+    conv = Conv2d(in_f, out_f, kernel_size=kernel, stride=stride)
+    if conv_first:
+        seq: List[Layer] = [conv]
+        if batch_norm:
+            seq.append(BatchNorm(out_f))
+        if activation:
+            seq.append(ReLU())
+    else:
+        seq = []
+        if batch_norm:
+            seq.append(BatchNorm(in_f))
+        if activation:
+            seq.append(ReLU())
+        seq.append(conv)
+    return seq
+
+
+@dataclasses.dataclass
+class ResBlockV1(Cell):
+    """v1 basic residual cell (reference make_cell_v1, resnet.py:81-113)."""
+
+    in_f: int
+    out_f: int
+    stride: int
+    shortcut_conv: bool
+    name: str = "res_v1"
+
+    def __post_init__(self):
+        self.r1 = LayerCell(_resnet_layer(self.in_f, self.out_f, stride=self.stride))
+        self.r2 = LayerCell(_resnet_layer(self.out_f, self.out_f, activation=False))
+        self.r3 = (
+            LayerCell(
+                _resnet_layer(
+                    self.in_f, self.out_f, kernel=1, stride=self.stride,
+                    activation=False, batch_norm=False,
+                )
+            )
+            if self.shortcut_conv
+            else None
+        )
+
+    def init(self, key, in_shape):
+        k1, k2, k3 = jax.random.split(key, 3)
+        p1, s = self.r1.init(k1, in_shape)
+        p2, s = self.r2.init(k2, s)
+        params = {"r1": p1, "r2": p2}
+        if self.r3 is not None:
+            p3, _ = self.r3.init(k3, in_shape)
+            params["r3"] = p3
+        return params, s
+
+    def apply(self, params, x, ctx: ApplyCtx):
+        y = self.r1.apply(params["r1"], x, ctx)
+        y = self.r2.apply(params["r2"], y, ctx)
+        if self.r3 is not None:
+            x = self.r3.apply(params["r3"], x, ctx)
+        return jax.nn.relu(x + y)
+
+
+@dataclasses.dataclass
+class ResBlockV2(Cell):
+    """v2 pre-activation bottleneck cell (reference make_cell_v2,
+    resnet.py:180-230).  Note the reference's r1/r2 use 3x3 kernels and r3 is
+    the 1x1 expansion; there is no post-add ReLU."""
+
+    in_f: int
+    f1: int
+    f2: int
+    stride: int
+    first_block: bool  # resblock == 0 → conv shortcut
+    pre_activation: bool  # False only for stage0/block0 (act=None, bn=False)
+    name: str = "res_v2"
+
+    def __post_init__(self):
+        self.r1 = LayerCell(
+            _resnet_layer(
+                self.in_f, self.f1, stride=self.stride,
+                activation=self.pre_activation, batch_norm=self.pre_activation,
+                conv_first=False,
+            )
+        )
+        self.r2 = LayerCell(_resnet_layer(self.f1, self.f1, conv_first=False))
+        self.r3 = LayerCell(_resnet_layer(self.f1, self.f2, kernel=1, conv_first=False))
+        self.r4 = (
+            LayerCell(
+                _resnet_layer(
+                    self.in_f, self.f2, kernel=1, stride=self.stride,
+                    activation=False, batch_norm=False,
+                )
+            )
+            if self.first_block
+            else None
+        )
+
+    def init(self, key, in_shape):
+        k1, k2, k3, k4 = jax.random.split(key, 4)
+        p1, s = self.r1.init(k1, in_shape)
+        p2, s = self.r2.init(k2, s)
+        p3, s = self.r3.init(k3, s)
+        params = {"r1": p1, "r2": p2, "r3": p3}
+        if self.r4 is not None:
+            p4, _ = self.r4.init(k4, in_shape)
+            params["r4"] = p4
+        return params, s
+
+    def apply(self, params, x, ctx: ApplyCtx):
+        y = self.r1.apply(params["r1"], x, ctx)
+        y = self.r2.apply(params["r2"], y, ctx)
+        y = self.r3.apply(params["r3"], y, ctx)
+        if self.r4 is not None:
+            x = self.r4.apply(params["r4"], x, ctx)
+        return x + y
+
+
+def _head(
+    num_filters: int,
+    num_classes: int,
+    pool_kernel: int,
+    with_bn: bool,
+    softmax_in_model: bool,
+    feature_hw: int,
+) -> LayerCell:
+    """avg-pool + flatten + FC head (reference end_part_v1/v2,
+    resnet.py:117-142, :234-267)."""
+    seq: List[Layer] = []
+    if with_bn:
+        seq += [BatchNorm(num_filters), ReLU()]
+    seq.append(Pool2d("avg", pool_kernel))
+    seq.append(Flatten())
+    flat = num_filters * (feature_hw // pool_kernel) ** 2
+    seq.append(Dense(flat, num_classes))
+    if softmax_in_model:
+        seq.append(Softmax())
+    return LayerCell(seq, name="head")
+
+
+def get_resnet_v1(
+    in_shape: Tuple[int, int, int, int],
+    depth: int,
+    num_classes: int = 10,
+    softmax_in_model: bool = False,
+) -> CellModel:
+    if (depth - 2) % 6 != 0:
+        raise ValueError("depth should be 6n+2 (e.g. 20, 32, 44)")
+    n_blocks = (depth - 2) // 6
+    cells: List[Cell] = [LayerCell(_resnet_layer(3, 16), name="stem")]
+    in_f, f = 16, 16
+    for stack in range(3):
+        for block in range(n_blocks):
+            stride = 2 if (stack > 0 and block == 0) else 1
+            cells.append(
+                ResBlockV1(
+                    in_f, f, stride,
+                    shortcut_conv=(block == 0 and stack > 0),
+                    name=f"s{stack}b{block}",
+                )
+            )
+            in_f = f
+        f *= 2
+    feature_hw = in_shape[1] // 4  # two stride-2 stages
+    cells.append(_head(in_f, num_classes, 8, False, softmax_in_model, feature_hw))
+    return CellModel(cells, in_shape, num_classes, name=f"resnet{depth}_v1")
+
+
+def get_resnet_v2(
+    in_shape: Tuple[int, int, int, int],
+    depth: int,
+    num_classes: int = 10,
+    softmax_in_model: bool = False,
+) -> CellModel:
+    if (depth - 2) % 9 != 0:
+        raise ValueError("depth should be 9n+2 (e.g. 56, 110)")
+    n_blocks = (depth - 2) // 9
+    cells: List[Cell] = [LayerCell(_resnet_layer(3, 16), name="stem")]
+    in_f, f_in = 16, 16
+    for stage in range(3):
+        for block in range(n_blocks):
+            stride = 1
+            pre_act = True
+            if stage == 0:
+                f_out = f_in * 4
+                if block == 0:
+                    pre_act = False
+            else:
+                f_out = f_in * 2
+                if block == 0:
+                    stride = 2
+            cells.append(
+                ResBlockV2(
+                    in_f, f_in, f_out, stride,
+                    first_block=(block == 0), pre_activation=pre_act,
+                    name=f"s{stage}b{block}",
+                )
+            )
+            in_f = f_out
+        f_in = f_out
+    feature_hw = in_shape[1] // 4
+    cells.append(_head(in_f, num_classes, 8, True, softmax_in_model, feature_hw))
+    return CellModel(cells, in_shape, num_classes, name=f"resnet{depth}_v2")
+
+
+def get_resnet(
+    in_shape,
+    depth: int,
+    num_classes: int = 10,
+    version: int = 2,
+    softmax_in_model: bool = False,
+) -> CellModel:
+    fn = get_resnet_v1 if version == 1 else get_resnet_v2
+    return fn(in_shape, depth, num_classes, softmax_in_model)

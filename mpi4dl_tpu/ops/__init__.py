@@ -1,0 +1,13 @@
+from mpi4dl_tpu.ops.halo import (
+    halo_exchange_1d,
+    halo_exchange_2d,
+    halo_exchange_with_mask,
+    HaloSpec,
+)
+
+__all__ = [
+    "halo_exchange_1d",
+    "halo_exchange_2d",
+    "halo_exchange_with_mask",
+    "HaloSpec",
+]

@@ -1,0 +1,281 @@
+"""Training steps: loss, optimizer, and jitted step builders.
+
+The reference's training loops live in benchmark scripts + runtime classes
+(`train_model.run_step/update`, mp_pipeline.py:509-538).  Here each regime is
+a *builder* returning one jitted function `(state, batch) -> (state, metrics)`:
+
+- :func:`make_train_step` — single device or pure DP (pjit over ``data``).
+- :func:`make_spatial_train_step` — SP(+DP): shard_map over sph/spw(+data),
+  halo convs inside, psum'd grads (the tile group doubles as a DP group for
+  gradients, exactly the reference's create_allreduce_comm_spatial,
+  comm.py:197-248).
+- Pipeline/GEMS steps live in parallel/pipeline.py and parallel/gems.py.
+
+Loss: softmax cross-entropy on logits (the reference's CrossEntropyLoss after
+an in-model softmax is a double-softmax quirk, reproduced only when the model
+was built with ``softmax_in_model=True``; then we take log of the model's
+probabilities instead).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mpi4dl_tpu.cells import CellModel
+from mpi4dl_tpu.layer_ctx import ApplyCtx, SpatialCtx
+
+
+def cross_entropy(logits_or_probs: jax.Array, labels: jax.Array,
+                  from_probs: bool = False) -> jax.Array:
+    """Mean softmax cross-entropy with integer labels."""
+    x = logits_or_probs.astype(jnp.float32)
+    if from_probs:
+        logp = jnp.log(jnp.clip(x, 1e-20, 1.0))
+    else:
+        logp = jax.nn.log_softmax(x, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32), axis=-1)
+    return jnp.mean(nll)
+
+
+def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    return jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# Optimizer — minimal SGD(+momentum) and Adam over arbitrary pytrees.
+# (The reference uses torch.optim.SGD(lr=0.001); optax is available but the
+# pipeline engine works on flat stage buffers where a hand-rolled update is
+# clearer and allocation-free.)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Optimizer:
+    kind: str = "sgd"
+    lr: float = 0.001
+    momentum: float = 0.0
+    b1: float = 0.9
+    b2: float = 0.999
+    eps: float = 1e-8
+
+    def init(self, params):
+        if self.kind == "sgd" and self.momentum == 0.0:
+            return ()
+        if self.kind == "sgd":
+            return (jax.tree.map(jnp.zeros_like, params),)
+        if self.kind == "adam":
+            z = jax.tree.map(jnp.zeros_like, params)
+            return (z, jax.tree.map(jnp.zeros_like, params), jnp.zeros((), jnp.int32))
+        raise ValueError(self.kind)
+
+    def update(self, params, grads, opt_state):
+        if self.kind == "sgd" and self.momentum == 0.0:
+            new = jax.tree.map(lambda p, g: p - self.lr * g.astype(p.dtype), params, grads)
+            return new, ()
+        if self.kind == "sgd":
+            (vel,) = opt_state
+            vel = jax.tree.map(lambda v, g: self.momentum * v + g.astype(v.dtype), vel, grads)
+            new = jax.tree.map(lambda p, v: p - self.lr * v, params, vel)
+            return new, (vel,)
+        if self.kind == "adam":
+            m, v, t = opt_state
+            t = t + 1
+            m = jax.tree.map(lambda a, g: self.b1 * a + (1 - self.b1) * g.astype(a.dtype), m, grads)
+            v = jax.tree.map(lambda a, g: self.b2 * a + (1 - self.b2) * jnp.square(g.astype(a.dtype)), v, grads)
+            bc1 = 1 - self.b1 ** t.astype(jnp.float32)
+            bc2 = 1 - self.b2 ** t.astype(jnp.float32)
+            new = jax.tree.map(
+                lambda p, mm, vv: p - self.lr * (mm / bc1) / (jnp.sqrt(vv / bc2) + self.eps),
+                params, m, v,
+            )
+            return new, (m, v, t)
+        raise ValueError(self.kind)
+
+
+@dataclasses.dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+    @staticmethod
+    def create(params, optimizer: Optimizer) -> "TrainState":
+        return TrainState(params, optimizer.init(params), jnp.zeros((), jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# Single-device / DP train step
+# ---------------------------------------------------------------------------
+
+
+def make_loss_fn(model: CellModel, ctx: ApplyCtx, from_probs: bool = False):
+    def loss_fn(params_list, x, labels):
+        logits = model.apply(params_list, x, ctx)
+        if isinstance(logits, tuple):
+            logits = logits[0]
+        return cross_entropy(logits, labels, from_probs), logits
+
+    return loss_fn
+
+
+def make_train_step(
+    model: CellModel,
+    optimizer: Optimizer,
+    mesh: Optional[Mesh] = None,
+    parts: int = 1,
+    compute_dtype=jnp.float32,
+    from_probs: bool = False,
+):
+    """Single-device or DP (batch sharded over 'data') training step.
+
+    `parts` > 1 runs the micro-batch gradient-accumulation loop via lax.scan —
+    the degenerate (split_size=1) form of the reference's GPipe parts loop.
+    """
+    ctx = ApplyCtx(train=True)
+    loss_fn = make_loss_fn(model, ctx, from_probs)
+
+    def grads_for(params, x, labels):
+        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, x.astype(compute_dtype), labels
+        )
+        return loss, logits, grads
+
+    def step(state: TrainState, x, labels):
+        if parts == 1:
+            loss, logits, grads = grads_for(state.params, x, labels)
+            acc = accuracy(logits, labels)
+        else:
+            mb_x = x.reshape(parts, x.shape[0] // parts, *x.shape[1:])
+            mb_y = labels.reshape(parts, labels.shape[0] // parts)
+            zero = jax.tree.map(jnp.zeros_like, state.params)
+
+            def body(carry, mb):
+                g_acc, loss_acc, acc_acc = carry
+                loss, logits, grads = grads_for(state.params, mb[0], mb[1])
+                g_acc = jax.tree.map(jnp.add, g_acc, grads)
+                return (g_acc, loss_acc + loss, acc_acc + accuracy(logits, mb[1])), None
+
+            (grads, loss, acc), _ = lax.scan(
+                body, (zero, jnp.zeros(()), jnp.zeros(())), (mb_x, mb_y)
+            )
+            grads = jax.tree.map(lambda g: g / parts, grads)
+            loss, acc = loss / parts, acc / parts
+        params, opt_state = optimizer.update(state.params, grads, state.opt_state)
+        return (
+            TrainState(params, opt_state, state.step + 1),
+            {"loss": loss, "accuracy": acc},
+        )
+
+    if mesh is None:
+        return jax.jit(step)
+
+    # DP: batch sharded over 'data'; params replicated.  XLA inserts the
+    # gradient all-reduce (the reference's SyncAllreduce, comm.py:440-514).
+    data_spec = NamedSharding(mesh, P("data"))
+    repl = NamedSharding(mesh, P())
+    jstep = jax.jit(
+        step,
+        in_shardings=(None, data_spec, data_spec),
+        out_shardings=(None, None),
+    )
+    return jstep
+
+
+# ---------------------------------------------------------------------------
+# Spatial-parallel (SP [+DP]) train step via shard_map
+# ---------------------------------------------------------------------------
+
+
+def spatial_partition_spec(sp: SpatialCtx, data: bool = False) -> P:
+    """PartitionSpec for an NHWC batch under a SpatialCtx (the analog of the
+    reference's split_input slicing, train_spatial.py:241-290)."""
+    return P("data" if data else None, sp.axis_h, sp.axis_w, None)
+
+
+def make_spatial_train_step(
+    model: CellModel,
+    optimizer: Optimizer,
+    mesh: Mesh,
+    sp: SpatialCtx,
+    parts: int = 1,
+    with_data_axis: bool = False,
+    compute_dtype=jnp.float32,
+    from_probs: bool = False,
+):
+    """SP(+DP) training step: one shard_map over the whole step.
+
+    Inside, convs/pools halo-exchange over sph/spw; the head's GlobalAvgPool
+    pmean acts as the SP→replicated junction; gradients are psum'd over the
+    spatial axes (+ data axis when present) — the spatial tile group being a
+    gradient DP group is exactly reference comm.py:197-248.
+    """
+    ctx = ApplyCtx(train=True, spatial=sp, data_axis="data" if with_data_axis else None)
+    loss_fn = make_loss_fn(model, ctx, from_probs)
+    grad_axes = tuple(a for a in (sp.axis_h, sp.axis_w) if a)
+    if with_data_axis:
+        grad_axes = ("data",) + grad_axes
+
+    x_spec = spatial_partition_spec(sp, data=with_data_axis)
+    y_spec = P("data") if with_data_axis else P()
+
+    def sharded_step(params, opt_state, x, labels):
+        def grads_for(p, xx, yy):
+            (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                p, xx.astype(compute_dtype), yy
+            )
+            return loss, logits, grads
+
+        if parts == 1:
+            loss, logits, grads = grads_for(params, x, labels)
+            acc = accuracy(logits, labels)
+        else:
+            mb_x = x.reshape(parts, x.shape[0] // parts, *x.shape[1:])
+            mb_y = labels.reshape(parts, labels.shape[0] // parts)
+            zero = jax.tree.map(jnp.zeros_like, params)
+
+            def body(carry, mb):
+                g_acc, l_acc, a_acc = carry
+                loss, logits, grads = grads_for(params, mb[0], mb[1])
+                return (
+                    jax.tree.map(jnp.add, g_acc, grads),
+                    l_acc + loss,
+                    a_acc + accuracy(logits, mb[1]),
+                ), None
+
+            (grads, loss, acc), _ = lax.scan(
+                body, (zero, jnp.zeros(()), jnp.zeros(())), (mb_x, mb_y)
+            )
+            grads = jax.tree.map(lambda g: g / parts, grads)
+            loss, acc = loss / parts, acc / parts
+
+        grads = jax.tree.map(lambda g: lax.pmean(g, grad_axes), grads)
+        new_params, new_opt = optimizer.update(params, grads, opt_state)
+        metrics = {
+            "loss": lax.pmean(loss, grad_axes),
+            "accuracy": lax.pmean(acc, grad_axes),
+        }
+        return new_params, new_opt, metrics
+
+    from jax import shard_map
+
+    smapped = shard_map(
+        sharded_step,
+        mesh=mesh,
+        in_specs=(P(), P(), x_spec, y_spec),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def step(state: TrainState, x, labels):
+        params, opt_state, metrics = smapped(state.params, state.opt_state, x, labels)
+        return TrainState(params, opt_state, state.step + 1), metrics
+
+    return step

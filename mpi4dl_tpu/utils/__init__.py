@@ -1,0 +1,3 @@
+from mpi4dl_tpu.utils.misc import is_power_two, get_depth, Timer, StepMeter
+
+__all__ = ["is_power_two", "get_depth", "Timer", "StepMeter"]

@@ -1,0 +1,64 @@
+"""Small utilities (reference: src/torchgems/utils.py, timing in benchmarks)."""
+
+from __future__ import annotations
+
+import statistics
+import time
+from typing import List
+
+
+def is_power_two(n: int) -> bool:
+    """True iff n is a power of two (reference utils.py:20-21)."""
+    return n > 0 and (n & (n - 1)) == 0
+
+
+def get_depth(version: int, n: int) -> int:
+    """ResNet depth formula (reference utils.py:26-30): v1 → 6n+2, v2 → 9n+2."""
+    if version == 1:
+        return n * 6 + 2
+    elif version == 2:
+        return n * 9 + 2
+    raise ValueError(f"unknown resnet version {version}")
+
+
+class Timer:
+    """Wall-clock timer for a single region; call start/stop, read .ms."""
+
+    def __init__(self) -> None:
+        self._t0 = 0.0
+        self.ms = 0.0
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def stop(self) -> float:
+        self.ms = (time.perf_counter() - self._t0) * 1e3
+        return self.ms
+
+
+class StepMeter:
+    """Collects per-step times and prints images/sec the way the reference
+    benchmarks do (mean/median over steps, reference
+    benchmark_amoebanet_sp.py:322-367)."""
+
+    def __init__(self, batch_size: int) -> None:
+        self.batch_size = batch_size
+        self.times_ms: List[float] = []
+
+    def add(self, ms: float) -> None:
+        self.times_ms.append(ms)
+
+    def images_per_sec(self) -> float:
+        if not self.times_ms:
+            return 0.0
+        return self.batch_size / (statistics.mean(self.times_ms) / 1e3)
+
+    def summary(self) -> str:
+        if not self.times_ms:
+            return "no steps recorded"
+        mean = statistics.mean(self.times_ms)
+        med = statistics.median(self.times_ms)
+        return (
+            f"steps={len(self.times_ms)} mean={mean:.2f}ms median={med:.2f}ms "
+            f"images/sec={self.images_per_sec():.3f}"
+        )

@@ -1,0 +1,32 @@
+"""Test harness: force an 8-device CPU platform so every SP/PP/GEMS schedule
+runs as a real SPMD program in pytest (SURVEY §4: the harness the reference
+lacks — its numerical validation needs a 4-5 GPU MPI launch)."""
+
+import os
+
+# The axon TPU plugin's sitecustomize imports jax at interpreter startup, so
+# env vars are already baked; use config updates (they win over the cached env
+# as long as no backend has been initialized yet).
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_threefry_partitionable", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 CPU devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)

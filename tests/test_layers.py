@@ -1,0 +1,100 @@
+"""Unit tests for the functional layer library (replicated mode) against
+reference semantics, using torch (CPU) as an independent oracle where exact
+formulas matter."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi4dl_tpu.layer_ctx import ApplyCtx
+from mpi4dl_tpu.layers import (
+    BatchNorm,
+    Conv2d,
+    Dense,
+    Flatten,
+    GlobalAvgPool,
+    Pool2d,
+    ReLU,
+)
+
+CTX = ApplyCtx(train=True)
+ECTX = ApplyCtx(train=False)
+
+
+def test_conv_shapes_same_padding():
+    conv = Conv2d(3, 8, kernel_size=3, stride=1)
+    params, out_shape = conv.init(jax.random.key(0), (2, 16, 16, 3))
+    x = jnp.ones((2, 16, 16, 3))
+    y = conv.apply(params, x, CTX)
+    assert y.shape == (2, 16, 16, 8) == out_shape
+
+
+def test_conv_stride2_shape():
+    conv = Conv2d(4, 4, kernel_size=3, stride=2)
+    params, out_shape = conv.init(jax.random.key(0), (1, 32, 32, 4))
+    assert out_shape == (1, 16, 16, 4)
+
+
+def test_conv_matches_torch():
+    torch = pytest.importorskip("torch")
+    conv = Conv2d(3, 5, kernel_size=3, stride=2, padding=1)
+    params, _ = conv.init(jax.random.key(1), (2, 8, 8, 3))
+    x = np.random.default_rng(0).standard_normal((2, 8, 8, 3)).astype(np.float32)
+    y = conv.apply(params, jnp.asarray(x), CTX)
+
+    tconv = torch.nn.Conv2d(3, 5, 3, stride=2, padding=1)
+    with torch.no_grad():
+        tconv.weight.copy_(
+            torch.tensor(np.transpose(np.asarray(params["kernel"]), (3, 2, 0, 1)))
+        )
+        tconv.bias.copy_(torch.tensor(np.asarray(params["bias"])))
+        ty = tconv(torch.tensor(np.transpose(x, (0, 3, 1, 2))))
+    np.testing.assert_allclose(
+        np.asarray(y), np.transpose(ty.numpy(), (0, 2, 3, 1)), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_batchnorm_train_normalizes():
+    bn = BatchNorm(4)
+    params, _ = bn.init(jax.random.key(0), (8, 4, 4, 4))
+    x = jax.random.normal(jax.random.key(1), (8, 4, 4, 4)) * 3 + 2
+    y = bn.apply(params, x, CTX)
+    np.testing.assert_allclose(np.asarray(jnp.mean(y, axis=(0, 1, 2))), 0, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(jnp.std(y, axis=(0, 1, 2))), 1, atol=1e-3)
+
+
+def test_avgpool_count_include_pad_false_matches_torch():
+    torch = pytest.importorskip("torch")
+    pool = Pool2d("avg", 3, 1, 1, count_include_pad=False)
+    x = np.random.default_rng(2).standard_normal((1, 6, 6, 2)).astype(np.float32)
+    y = pool.apply({}, jnp.asarray(x), CTX)
+    ty = torch.nn.AvgPool2d(3, 1, 1, count_include_pad=False)(
+        torch.tensor(np.transpose(x, (0, 3, 1, 2)))
+    )
+    np.testing.assert_allclose(
+        np.asarray(y), np.transpose(ty.numpy(), (0, 2, 3, 1)), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_maxpool_padding_matches_torch():
+    torch = pytest.importorskip("torch")
+    pool = Pool2d("max", 3, 2, 1)
+    x = np.random.default_rng(3).standard_normal((2, 8, 8, 3)).astype(np.float32)
+    y = pool.apply({}, jnp.asarray(x), CTX)
+    ty = torch.nn.MaxPool2d(3, 2, 1)(torch.tensor(np.transpose(x, (0, 3, 1, 2))))
+    np.testing.assert_allclose(
+        np.asarray(y), np.transpose(ty.numpy(), (0, 2, 3, 1)), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_dense_flatten_global_pool():
+    gap = GlobalAvgPool()
+    x = jnp.arange(2 * 4 * 4 * 3, dtype=jnp.float32).reshape(2, 4, 4, 3)
+    y = gap.apply({}, x, CTX)
+    assert y.shape == (2, 3)
+    np.testing.assert_allclose(np.asarray(y[0]), np.asarray(jnp.mean(x[0], (0, 1))))
+
+    d = Dense(3, 7)
+    p, s = d.init(jax.random.key(0), (2, 3))
+    assert d.apply(p, y, CTX).shape == (2, 7) and s == (2, 7)

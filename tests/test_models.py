@@ -1,0 +1,68 @@
+"""Model construction + forward smoke tests (small geometries), including the
+shape-list inference that replaces the reference's two-phase probe."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi4dl_tpu.cells import split_even
+from mpi4dl_tpu.layer_ctx import ApplyCtx
+from mpi4dl_tpu.models.amoebanet import amoebanetd
+from mpi4dl_tpu.models.resnet import get_resnet_v1, get_resnet_v2
+
+CTX = ApplyCtx(train=True)
+
+
+def test_resnet_v1_forward():
+    model = get_resnet_v1((2, 32, 32, 3), depth=20, num_classes=10)
+    params, shapes = model.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (2, 32, 32, 3))
+    y = model.apply(params, x, CTX)
+    assert y.shape == (2, 10)
+    assert shapes[-1] == (2, 10)
+
+
+def test_resnet_v2_forward_and_shapes():
+    model = get_resnet_v2((2, 32, 32, 3), depth=29, num_classes=10)
+    params, shapes = model.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (2, 32, 32, 3))
+    y = model.apply(params, x, CTX)
+    assert y.shape == (2, 10)
+    # eval_shape-based inference agrees with init-time propagation
+    inferred = model.out_shapes(params)
+    assert inferred == shapes
+
+
+def test_resnet_cell_count_matches_depth_formula():
+    # depth 9n+2 → n cells per stage * 3 + stem + head (reference get_depth)
+    model = get_resnet_v2((1, 32, 32, 3), depth=29)
+    assert len(model.cells) == 3 * 3 + 2
+
+
+def test_amoebanet_forward_tuple_state():
+    model = amoebanetd((2, 64, 64, 3), num_classes=10, num_layers=3, num_filters=64)
+    params, shapes = model.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (2, 64, 64, 3))
+    y = model.apply(params, x, CTX)
+    assert y.shape == (2, 10)
+    # intermediate cells carry (x, skip) tuple state
+    assert isinstance(shapes[1], tuple) and isinstance(shapes[1][0], tuple)
+
+
+def test_amoebanet_cell_count():
+    # stem + 2 reduction stems + 3*(num_layers//3) normal + 2 reduction + head
+    model = amoebanetd((1, 64, 64, 3), num_layers=6, num_filters=64)
+    assert len(model.cells) == 1 + 2 + 6 + 2 + 1
+
+
+def test_split_even_matches_reference_semantics():
+    assert split_even(10, 4) == [(0, 3), (3, 6), (6, 8), (8, 10)]
+    assert split_even(9, 3, balance=[2, 3, 4]) == [(0, 2), (2, 5), (5, 9)]
+
+
+def test_softmax_in_model_flag():
+    m = get_resnet_v2((1, 32, 32, 3), depth=11, softmax_in_model=True)
+    params, _ = m.init(jax.random.key(0))
+    y = m.apply(params, jnp.ones((1, 32, 32, 3)), CTX)
+    np.testing.assert_allclose(float(jnp.sum(y)), 1.0, rtol=1e-5)
