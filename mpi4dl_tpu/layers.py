@@ -288,6 +288,35 @@ class Flatten(Layer):
 # ---------------------------------------------------------------------------
 
 
+def _window_reduce(x, kh, kw, sh, sw, ph, pw, op: str):
+    """Differentiable window reduction (max/add) over NHWC.
+
+    Non-overlapping unpadded windows use a reshape; otherwise the k*k shifted
+    strided slices are reduced elementwise (k ≤ 8 here, so ≤ 64 fused ops).
+    """
+    n, h, w, c = x.shape
+    if ph == 0 and pw == 0 and kh == sh and kw == sw and h % kh == 0 and w % kw == 0:
+        r = x.reshape(n, h // kh, kh, w // kw, kw, c)
+        return jnp.max(r, axis=(2, 4)) if op == "max" else jnp.sum(r, axis=(2, 4))
+    if ph or pw:
+        fill = jnp.asarray(-jnp.inf if op == "max" else 0, x.dtype)
+        x = jnp.pad(x, ((0, 0), (ph, ph), (pw, pw), (0, 0)), constant_values=fill)
+        h, w = h + 2 * ph, w + 2 * pw
+    oh = (h - kh) // sh + 1
+    ow = (w - kw) // sw + 1
+    acc = None
+    for i in range(kh):
+        for j in range(kw):
+            piece = x[:, i : i + (oh - 1) * sh + 1 : sh, j : j + (ow - 1) * sw + 1 : sw, :]
+            if acc is None:
+                acc = piece
+            elif op == "max":
+                acc = jnp.maximum(acc, piece)
+            else:
+                acc = acc + piece
+    return acc
+
+
 @dataclasses.dataclass(frozen=True)
 class Pool2d(Layer):
     """Max/Avg pooling with exact distributed semantics.
@@ -345,25 +374,21 @@ class Pool2d(Layer):
             mask = jnp.ones(x.shape[:-1] + (1,), x.dtype) if need_mask else None
             rem_ph, rem_pw = ph, pw
 
-        pad_cfg = ((0, 0), (rem_ph, rem_ph), (rem_pw, rem_pw), (0, 0))
-
+        # NOTE: implemented with shifted-slice reductions rather than
+        # lax.reduce_window — reduce_window's reverse-mode AD is unsupported
+        # inside shard_map (jax 0.9), and for the small kernels CNNs use the
+        # unrolled form fuses just as well on TPU.
         if self.op == "max":
             neg = jnp.asarray(-jnp.inf, x.dtype)
             if mask is not None:
                 x = jnp.where(mask > 0, x, neg)
-            y = lax.reduce_window(
-                x, neg, lax.max, (1, kh, kw, 1), (1, sh, sw, 1), pad_cfg
-            )
+            y = _window_reduce(x, kh, kw, sh, sw, rem_ph, rem_pw, "max")
             return y
         # avg
-        ysum = lax.reduce_window(
-            x, jnp.asarray(0, x.dtype), lax.add, (1, kh, kw, 1), (1, sh, sw, 1), pad_cfg
-        )
+        ysum = _window_reduce(x, kh, kw, sh, sw, rem_ph, rem_pw, "add")
         if self.count_include_pad or (ph == 0 and pw == 0):
             return ysum / jnp.asarray(kh * kw, x.dtype)
-        div = lax.reduce_window(
-            mask, jnp.asarray(0, x.dtype), lax.add, (1, kh, kw, 1), (1, sh, sw, 1), pad_cfg
-        )
+        div = _window_reduce(mask, kh, kw, sh, sw, rem_ph, rem_pw, "add")
         return ysum / jnp.maximum(div, 1)
 
 

@@ -1,0 +1,11 @@
+from mpi4dl_tpu.parallel.spatial import (
+    gather_spatial,
+    scatter_batch_over_tiles,
+    apply_spatial_model,
+)
+
+__all__ = [
+    "gather_spatial",
+    "scatter_batch_over_tiles",
+    "apply_spatial_model",
+]

@@ -1,0 +1,105 @@
+"""Spatial-parallel region handling and the SP→LP junction.
+
+The reference moves data between its spatial region and the following
+layer-parallel region with a rank-indexed gather/concat mosaic
+(``train_spatial.py:690-721`` receive-from-all-tiles,
+``:1083-1188`` merge_inputs_joint_cat) or a scatter/gather pair for
+LOCAL_DP_LP (``:809-1028``).  On TPU both junctions are one collective:
+
+- ``gather_spatial``: ``lax.all_gather(tiled=True)`` over the spatial axes —
+  every device holds the full activation (replicated tail; fine for heads).
+- ``scatter_batch_over_tiles``: gather + slice the batch by the device's tile
+  linear index — the LOCAL_DP_LP junction (each former tile device trains the
+  tail on its own micro-slice of the batch).
+
+``apply_spatial_model`` runs a CellModel with the first ``spatial_until``
+cells under spatial sharding and the rest replicated/batch-split — the analog
+of the reference's spatial model variants that switch conv_spatial off past
+``end_layer`` (amoebanet.py:618-710, resnet_spatial.py:272-296).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mpi4dl_tpu.cells import CellModel
+from mpi4dl_tpu.layer_ctx import ApplyCtx, SpatialCtx
+
+Act = Union[jax.Array, Tuple[jax.Array, ...]]
+
+
+def _map_act(fn, x: Act) -> Act:
+    if isinstance(x, tuple):
+        return tuple(fn(t) for t in x)
+    return fn(x)
+
+
+def gather_spatial(x: Act, sp: SpatialCtx, h_dim: int = 1, w_dim: int = 2) -> Act:
+    """Reassemble the full (global-H/W) tensor from tiles on every device."""
+
+    def g(t):
+        if sp.axis_h and sp.grid_h > 1:
+            t = lax.all_gather(t, sp.axis_h, axis=h_dim, tiled=True)
+        if sp.axis_w and sp.grid_w > 1:
+            t = lax.all_gather(t, sp.axis_w, axis=w_dim, tiled=True)
+        return t
+
+    return _map_act(g, x)
+
+
+def tile_linear_index(sp: SpatialCtx) -> jax.Array:
+    """This device's tile index in row-major (reference local_rank ordering,
+    split_input train_spatial.py:241-290)."""
+    idx = jnp.zeros((), jnp.int32)
+    if sp.axis_h and sp.grid_h > 1:
+        idx = idx + lax.axis_index(sp.axis_h) * sp.grid_w
+    if sp.axis_w and sp.grid_w > 1:
+        idx = idx + lax.axis_index(sp.axis_w)
+    return idx
+
+
+def scatter_batch_over_tiles(x: Act, sp: SpatialCtx) -> Act:
+    """LOCAL_DP_LP junction: full tensor → per-device batch shard."""
+    tiles = sp.grid_h * sp.grid_w
+    t0 = x[0] if isinstance(x, tuple) else x
+    n = t0.shape[0]
+    assert n % tiles == 0, f"batch {n} not divisible by {tiles} tiles"
+    shard = n // tiles
+    start = tile_linear_index(sp) * shard
+
+    def s(t):
+        return lax.dynamic_slice_in_dim(t, start, shard, axis=0)
+
+    return _map_act(s, x)
+
+
+def apply_spatial_model(
+    model: CellModel,
+    params_list,
+    x: Act,
+    ctx: ApplyCtx,
+    spatial_until: Optional[int] = None,
+    junction: str = "gather",
+) -> Act:
+    """Run cells [0, spatial_until) spatially sharded, junction, then the tail
+    replicated (junction='gather') or batch-split (junction='batch_split').
+
+    Must be called inside shard_map with ctx.spatial set.  With
+    spatial_until=None, all cells except the final head run spatially (safe
+    because heads flatten/pool to per-image vectors).
+    """
+    sp = ctx.spatial
+    assert sp is not None and sp.active, "apply_spatial_model needs an active SpatialCtx"
+    if spatial_until is None:
+        spatial_until = model.spatial_until or (len(model.cells) - 1)
+
+    x = model.apply(params_list, x, ctx, start=0, stop=spatial_until)
+    x = gather_spatial(x, sp)
+    if junction == "batch_split":
+        x = scatter_batch_over_tiles(x, sp)
+    tail_ctx = ctx.with_spatial(None)
+    return model.apply(params_list, x, tail_ctx, start=spatial_until)

@@ -99,6 +99,7 @@ class Optimizer:
         raise ValueError(self.kind)
 
 
+@jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class TrainState:
     params: Any
@@ -208,16 +209,34 @@ def make_spatial_train_step(
     with_data_axis: bool = False,
     compute_dtype=jnp.float32,
     from_probs: bool = False,
+    spatial_until: Optional[int] = None,
+    junction: str = "gather",
 ):
     """SP(+DP) training step: one shard_map over the whole step.
 
-    Inside, convs/pools halo-exchange over sph/spw; the head's GlobalAvgPool
-    pmean acts as the SP→replicated junction; gradients are psum'd over the
-    spatial axes (+ data axis when present) — the spatial tile group being a
-    gradient DP group is exactly reference comm.py:197-248.
+    Inside, convs/pools halo-exchange over sph/spw; after `spatial_until`
+    cells the activation is gathered (SP→LP junction; 'batch_split' = the
+    LOCAL_DP_LP variant); gradients are psum'd over the spatial axes (+ data
+    axis when present) — the spatial tile group being a gradient DP group is
+    exactly reference comm.py:197-248.
     """
+    from mpi4dl_tpu.parallel.spatial import apply_spatial_model, tile_linear_index
+
     ctx = ApplyCtx(train=True, spatial=sp, data_axis="data" if with_data_axis else None)
-    loss_fn = make_loss_fn(model, ctx, from_probs)
+
+    def loss_fn(params_list, x, labels):
+        logits = apply_spatial_model(
+            model, params_list, x, ctx, spatial_until=spatial_until, junction=junction
+        )
+        if isinstance(logits, tuple):
+            logits = logits[0]
+        if junction == "batch_split":
+            tiles = sp.grid_h * sp.grid_w
+            shard = labels.shape[0] // tiles
+            labels = lax.dynamic_slice_in_dim(
+                labels, tile_linear_index(sp) * shard, shard, axis=0
+            )
+        return cross_entropy(logits, labels, from_probs), (logits, labels)
     grad_axes = tuple(a for a in (sp.axis_h, sp.axis_w) if a)
     if with_data_axis:
         grad_axes = ("data",) + grad_axes
@@ -227,14 +246,13 @@ def make_spatial_train_step(
 
     def sharded_step(params, opt_state, x, labels):
         def grads_for(p, xx, yy):
-            (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            (loss, (logits, yy_used)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 p, xx.astype(compute_dtype), yy
             )
-            return loss, logits, grads
+            return loss, accuracy(logits, yy_used), grads
 
         if parts == 1:
-            loss, logits, grads = grads_for(params, x, labels)
-            acc = accuracy(logits, labels)
+            loss, acc, grads = grads_for(params, x, labels)
         else:
             mb_x = x.reshape(parts, x.shape[0] // parts, *x.shape[1:])
             mb_y = labels.reshape(parts, labels.shape[0] // parts)
@@ -242,11 +260,11 @@ def make_spatial_train_step(
 
             def body(carry, mb):
                 g_acc, l_acc, a_acc = carry
-                loss, logits, grads = grads_for(params, mb[0], mb[1])
+                loss, acc, grads = grads_for(params, mb[0], mb[1])
                 return (
                     jax.tree.map(jnp.add, g_acc, grads),
                     l_acc + loss,
-                    a_acc + accuracy(logits, mb[1]),
+                    a_acc + acc,
                 ), None
 
             (grads, loss, acc), _ = lax.scan(

@@ -1,0 +1,142 @@
+"""Halo-exchange numerical validation.
+
+Port of the reference's canonical correctness fixture
+(benchmark_sp_halo_exchange.py:417-578): a deterministic arange image whose
+pixel values encode global position is tiled across devices, halos are
+exchanged, and each device's extended tile is exact-compared against the
+corresponding window of the globally zero-padded image — for vertical,
+horizontal and square slice methods.  Unlike the reference this runs in
+pytest on an 8-device CPU mesh, no MPI launch required.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from mpi4dl_tpu.mesh import MeshSpec, build_mesh
+from mpi4dl_tpu.ops.halo import HaloSpec, halo_exchange_1d, halo_exchange_2d
+
+
+def arange_image(h, w, c=1, n=1):
+    return (
+        jnp.arange(1, n * h * w * c + 1, dtype=jnp.float32).reshape(n, h, w, c)
+    )
+
+
+def expected_windows(img, halo, grid_h, grid_w):
+    """Globally zero-pad, then cut per-tile windows (what each device must
+    hold after exchange)."""
+    n, h, w, c = img.shape
+    padded = np.pad(np.asarray(img), ((0, 0), (halo, halo), (halo, halo), (0, 0)))
+    th, tw = h // grid_h, w // grid_w
+    out = []
+    for r in range(grid_h):
+        for cc in range(grid_w):
+            out.append(
+                padded[
+                    :, r * th : (r + 1) * th + 2 * halo,
+                    cc * tw : (cc + 1) * tw + 2 * halo,
+                ]
+            )
+    return out
+
+
+@pytest.mark.parametrize("halo", [1, 2, 3])
+@pytest.mark.parametrize("slice_method", ["vertical", "horizontal", "square"])
+def test_halo_exchange_matches_zero_padded_window(devices8, slice_method, halo):
+    if slice_method == "square":
+        grid_h, grid_w = 2, 2
+        mesh = build_mesh(MeshSpec(sph=2, spw=2), devices8)
+        spec = P(None, "sph", "spw", None)
+        axis_h, axis_w = "sph", "spw"
+    elif slice_method == "horizontal":
+        grid_h, grid_w = 4, 1
+        mesh = build_mesh(MeshSpec(sph=4), devices8)
+        spec = P(None, "sph", None, None)
+        axis_h, axis_w = "sph", None
+    else:  # vertical
+        grid_h, grid_w = 1, 4
+        mesh = build_mesh(MeshSpec(spw=4), devices8)
+        spec = P(None, None, "spw", None)
+        axis_h, axis_w = None, "spw"
+
+    img = arange_image(16, 16)
+
+    def exchange(tile):
+        return halo_exchange_2d(
+            tile,
+            HaloSpec.symmetric(halo if grid_h > 1 else 0),
+            HaloSpec.symmetric(halo if grid_w > 1 else 0),
+            axis_h, axis_w, grid_h, grid_w,
+        )
+
+    out_spec = P(None, "sph" if grid_h > 1 else None, "spw" if grid_w > 1 else None, None)
+    f = jax.jit(
+        shard_map(exchange, mesh=mesh, in_specs=spec, out_specs=out_spec)
+    )
+    result = f(img)
+
+    # For unsharded dims the exchange does not pad; emulate by slicing the
+    # expected windows accordingly.
+    exp = expected_windows(img, halo, grid_h, grid_w)
+    # Reassemble per-device shards from the sharded output
+    shards = [np.asarray(s.data) for s in result.addressable_shards]
+    idx = [
+        (s.index[1].start or 0, s.index[2].start or 0)
+        for s in result.addressable_shards
+    ]
+    order = np.argsort([r * 1000 + c for r, c in idx])
+    for k, si in enumerate(order):
+        e = exp[k]
+        if grid_h == 1:
+            e = e[:, halo:-halo, :]
+        if grid_w == 1:
+            e = e[:, :, halo:-halo]
+        np.testing.assert_array_equal(shards[si], e, err_msg=f"tile {k}")
+
+
+def test_halo_exchange_1d_asymmetric(devices8):
+    mesh = build_mesh(MeshSpec(sph=4), devices8)
+    x = arange_image(8, 4)
+
+    def f(tile):
+        return halo_exchange_1d(tile, 1, "sph", 4, HaloSpec(2, 1))
+
+    y = jax.jit(
+        shard_map(f, mesh=mesh, in_specs=P(None, "sph", None, None),
+                  out_specs=P(None, "sph", None, None))
+    )(x)
+    shards = sorted(
+        ((s.index[1].start or 0, np.asarray(s.data)) for s in y.addressable_shards),
+        key=lambda t: t[0],
+    )
+    padded = np.pad(np.asarray(x), ((0, 0), (2, 1), (0, 0), (0, 0)))
+    for k, (_, tile) in enumerate(shards):
+        np.testing.assert_array_equal(tile, padded[:, k * 2 : k * 2 + 2 + 3])
+
+
+def test_halo_grad_flows_back(devices8):
+    """ppermute transpose: gradient of a halo read lands on the neighbour that
+    owns the pixel (the reference gets this from autograd over copy-in
+    slicing; here from JAX AD of the collective)."""
+    mesh = build_mesh(MeshSpec(sph=4), devices8)
+
+    def loss(x):
+        ext = halo_exchange_1d(x, 1, "sph", 4, HaloSpec.symmetric(1))
+        return lax.psum(jnp.sum(ext), "sph")
+
+    g = jax.jit(
+        jax.grad(
+            lambda x: shard_map(
+                loss, mesh=mesh, in_specs=P(None, "sph", None, None), out_specs=P()
+            )(x)
+        )
+    )(jnp.ones((1, 8, 2, 1)))
+    g = np.asarray(g)[0, :, 0, 0]
+    # Interior rows adjacent to a tile boundary are read twice (own tile +
+    # neighbour halo) → grad 2; boundary-of-image rows only once.
+    expected = np.array([1, 2, 2, 2, 2, 2, 2, 1], dtype=np.float32)
+    np.testing.assert_array_equal(g, expected)

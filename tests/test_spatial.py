@@ -1,0 +1,154 @@
+"""Spatial-parallel execution must be numerically equivalent to single-device
+execution (the stronger form of the reference's halo+conv validation
+benchmarks, benchmark_sp_halo_exchange_with_compute_val.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from mpi4dl_tpu.layer_ctx import ApplyCtx, SpatialCtx, spatial_ctx_for
+from mpi4dl_tpu.layers import BatchNorm, Conv2d, Pool2d
+from mpi4dl_tpu.mesh import MeshSpec, build_mesh
+from mpi4dl_tpu.models.resnet import get_resnet_v2
+from mpi4dl_tpu.models.amoebanet import amoebanetd
+
+
+def _mesh_and_specs(slice_method, devices):
+    sp = spatial_ctx_for(slice_method, 4)
+    spec = MeshSpec(sph=sp.grid_h, spw=sp.grid_w)
+    mesh = build_mesh(spec, devices)
+    data_spec = P(None, sp.axis_h, sp.axis_w, None)
+    return sp, mesh, data_spec
+
+
+def _run_sharded(fn, mesh, in_spec, out_spec, *args):
+    return jax.jit(
+        shard_map(fn, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
+                  check_vma=False)
+    )(*args)
+
+
+@pytest.mark.parametrize("slice_method", ["vertical", "horizontal", "square"])
+@pytest.mark.parametrize("stride", [1, 2])
+def test_conv_spatial_equals_single_device(devices8, slice_method, stride):
+    sp, mesh, data_spec = _mesh_and_specs(slice_method, devices8)
+    conv = Conv2d(3, 8, kernel_size=3, stride=stride)
+    params, _ = conv.init(jax.random.key(0), (2, 16, 16, 3))
+    x = jax.random.normal(jax.random.key(1), (2, 16, 16, 3))
+
+    ref = conv.apply(params, x, ApplyCtx(train=True))
+    ctx = ApplyCtx(train=True, spatial=sp)
+    out = _run_sharded(
+        lambda p, t: conv.apply(p, t, ctx), mesh, (P(), data_spec), data_spec, params, x
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("kernel", [(1, 7), (7, 1)])
+def test_conv_asymmetric_kernel_spatial(devices8, kernel):
+    """The AmoebaNet 1x7/7x1 ops are the asymmetric-halo edge case SURVEY
+    calls out as a hard part."""
+    sp, mesh, data_spec = _mesh_and_specs("square", devices8)
+    pad = ((kernel[0] - 1) // 2, (kernel[1] - 1) // 2)
+    conv = Conv2d(4, 4, kernel_size=kernel, stride=1, padding=pad, bias=False)
+    params, _ = conv.init(jax.random.key(0), (1, 16, 16, 4))
+    x = jax.random.normal(jax.random.key(1), (1, 16, 16, 4))
+    ref = conv.apply(params, x, ApplyCtx(train=True))
+    ctx = ApplyCtx(train=True, spatial=sp)
+    out = _run_sharded(
+        lambda p, t: conv.apply(p, t, ctx), mesh, (P(), data_spec), data_spec, params, x
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("op,count_include_pad", [("max", True), ("avg", False)])
+def test_pool_spatial_equals_single_device(devices8, op, count_include_pad):
+    sp, mesh, data_spec = _mesh_and_specs("square", devices8)
+    pool = Pool2d(op, 3, 2, 1, count_include_pad=count_include_pad)
+    x = jax.random.normal(jax.random.key(2), (2, 16, 16, 4))
+    ref = pool.apply({}, x, ApplyCtx(train=True))
+    ctx = ApplyCtx(train=True, spatial=sp)
+    out = _run_sharded(lambda t: pool.apply({}, t, ctx), mesh, data_spec, data_spec, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_batchnorm_cross_tile_stats(devices8):
+    sp, mesh, data_spec = _mesh_and_specs("square", devices8)
+    bn = BatchNorm(4)
+    params, _ = bn.init(jax.random.key(0), (2, 8, 8, 4))
+    x = jax.random.normal(jax.random.key(3), (2, 8, 8, 4)) * 2 + 1
+    ref = bn.apply(params, x, ApplyCtx(train=True))
+    ctx = ApplyCtx(train=True, spatial=sp)
+    out = _run_sharded(
+        lambda p, t: bn.apply(p, t, ctx), mesh, (P(), data_spec), data_spec, params, x
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("slice_method", ["vertical", "square"])
+def test_resnet_spatial_forward_equals_single_device(devices8, slice_method):
+    """Full spatial ResNet forward == sequential forward (the reference can
+    only eyeball loss curves for this; SURVEY §4)."""
+    sp, mesh, data_spec = _mesh_and_specs(slice_method, devices8)
+    model = get_resnet_v2((2, 32, 32, 3), depth=11, num_classes=10)
+    params, _ = model.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(4), (2, 32, 32, 3))
+    ref = model.apply(params, x, ApplyCtx(train=True))
+    ctx = ApplyCtx(train=True, spatial=sp)
+    from mpi4dl_tpu.parallel.spatial import apply_spatial_model
+
+    out = _run_sharded(
+        lambda p, t: apply_spatial_model(model, p, t, ctx), mesh,
+        (P(), data_spec), P(None, None), params, x,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=5e-4, atol=5e-4)
+
+
+def test_amoebanet_spatial_forward_equals_single_device(devices8):
+    sp, mesh, data_spec = _mesh_and_specs("square", devices8)
+    model = amoebanetd((1, 64, 64, 3), num_classes=10, num_layers=3, num_filters=64)
+    params, _ = model.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(5), (1, 64, 64, 3))
+    ref = model.apply(params, x, ApplyCtx(train=True))
+    ctx = ApplyCtx(train=True, spatial=sp)
+    from mpi4dl_tpu.parallel.spatial import apply_spatial_model
+
+    # Spatial region = first 4 cells (stem + 2 reduction stems + 1 normal):
+    # deeper cells' local tiles would shrink below kernel size at this tiny
+    # test geometry — the same reason the reference limits SP to the first
+    # `spatial_size` splits.
+    out = _run_sharded(
+        lambda p, t: apply_spatial_model(model, p, t, ctx, spatial_until=4), mesh,
+        (P(), data_spec), P(None, None), params, x,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
+def test_spatial_train_step_matches_single_device(devices8):
+    """Two SGD steps under SP == two steps single-device (bn_cross_tile)."""
+    from mpi4dl_tpu.train import Optimizer, TrainState, make_spatial_train_step, make_train_step
+
+    sp = spatial_ctx_for("square", 4)
+    mesh = build_mesh(MeshSpec(sph=2, spw=2), devices8)
+    model = get_resnet_v2((4, 32, 32, 3), depth=11, num_classes=10)
+    params, _ = model.init(jax.random.key(0))
+    opt = Optimizer("sgd", lr=0.01)
+
+    step_ref = make_train_step(model, opt)
+    step_sp = make_spatial_train_step(model, opt, mesh, sp)
+
+    s_ref = TrainState.create(params, opt)
+    s_sp = TrainState.create(params, opt)
+    x = jax.random.normal(jax.random.key(6), (4, 32, 32, 3))
+    y = jnp.array([0, 1, 2, 3], jnp.int32)
+    for _ in range(2):
+        s_ref, m_ref = step_ref(s_ref, x, y)
+        s_sp, m_sp = step_sp(s_sp, x, y)
+    np.testing.assert_allclose(float(m_ref["loss"]), float(m_sp["loss"]), rtol=1e-4)
+    leaves_r = jax.tree.leaves(s_ref.params)
+    leaves_s = jax.tree.leaves(s_sp.params)
+    for a, b in zip(leaves_r, leaves_s):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-4)
