@@ -1,0 +1,180 @@
+"""Stage partitioning + flat parameter/activation packing.
+
+The reference assigns contiguous cell ranges to ranks
+(``mp_pipeline.py:41-83``) and keeps per-rank parameter objects.  The TPU
+engine instead runs ONE SPMD program where every device holds its stage's
+parameters as a single flat fp32 vector, padded to the max stage size and
+sharded over the ``stage`` mesh axis.  Flat stage buffers are what make three
+things trivial that cost the reference real machinery:
+
+- heterogeneous stages under ``lax.switch`` (each branch statically unpacks
+  its own tree; buffers all have one shape),
+- the optimizer (elementwise over one vector; no per-layer loop),
+- GEMS mirror exchange (one ppermute of the whole stage's weights — the
+  reference builds contiguous flat views by re-pointing every torch parameter,
+  train_spatial_master.py:114-138).
+
+Activation boundaries likewise pack to flat vectors (tuple states — AmoebaNet
+(x, skip) — flatten transparently) padded to the max boundary size so the
+stage handoff is a single uniform ppermute.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpi4dl_tpu.cells import CellModel, split_even
+from mpi4dl_tpu.layer_ctx import ApplyCtx
+
+Act = Any
+
+
+# ---------------------------------------------------------------------------
+# Generic pytree <-> flat vector packing (static metadata)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class TreePack:
+    treedef: Any
+    shapes: Tuple[Tuple[int, ...], ...]
+    dtypes: Tuple[Any, ...]
+    sizes: Tuple[int, ...]
+
+    @property
+    def total(self) -> int:
+        return int(sum(self.sizes))
+
+    @classmethod
+    def of(cls, tree) -> "TreePack":
+        leaves, treedef = jax.tree.flatten(tree)
+        shapes = tuple(tuple(map(int, l.shape)) for l in leaves)
+        dtypes = tuple(l.dtype for l in leaves)
+        sizes = tuple(int(np.prod(s)) if s else 1 for s in shapes)
+        return cls(treedef, shapes, dtypes, sizes)
+
+    def pack(self, tree, dtype=jnp.float32) -> jax.Array:
+        leaves = jax.tree.leaves(tree)
+        if not leaves:
+            return jnp.zeros((0,), dtype)
+        return jnp.concatenate([jnp.ravel(l).astype(dtype) for l in leaves])
+
+    def unpack(self, vec: jax.Array, dtype=None):
+        leaves, off = [], 0
+        for shape, dt, size in zip(self.shapes, self.dtypes, self.sizes):
+            chunk = lax_slice(vec, off, size)
+            leaves.append(chunk.reshape(shape).astype(dtype or dt))
+            off += size
+        return jax.tree.unflatten(self.treedef, leaves)
+
+
+def lax_slice(vec, off: int, size: int):
+    return jax.lax.slice_in_dim(vec, off, off + size)
+
+
+def pad_to(vec: jax.Array, n: int) -> jax.Array:
+    if vec.shape[0] == n:
+        return vec
+    return jnp.pad(vec, (0, n - vec.shape[0]))
+
+
+# ---------------------------------------------------------------------------
+# Stage partition
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class StagePartition:
+    """Static description of a model split into S pipeline stages."""
+
+    model: CellModel
+    ranges: List[Tuple[int, int]]  # cell index ranges per stage
+    param_packs: List[TreePack]  # per-stage parameter packing
+    act_packs: List[TreePack]  # act_packs[s] = input structure of stage s
+    out_pack: TreePack  # output of last stage (logits)
+    param_max: int
+    act_max: int
+
+    @property
+    def num_stages(self) -> int:
+        return len(self.ranges)
+
+    @classmethod
+    def build(
+        cls,
+        model: CellModel,
+        params_list: Sequence[Any],
+        split_size: int,
+        microbatch_shape: Tuple[int, ...],
+        balance: Optional[Sequence[int]] = None,
+        compute_dtype=jnp.float32,
+    ) -> "StagePartition":
+        ranges = split_even(len(model.cells), split_size, balance)
+        param_packs = [
+            TreePack.of([params_list[i] for i in range(r0, r1)]) for r0, r1 in ranges
+        ]
+        # Boundary activation structures via eval_shape chain (the reference's
+        # two-phase shape probe, mp_pipeline.py:126-168, for free).
+        act_structs = []
+        x = jax.ShapeDtypeStruct(microbatch_shape, compute_dtype)
+        ctx = ApplyCtx(train=True)
+        for s, (r0, r1) in enumerate(ranges):
+            act_structs.append(x)
+            x = jax.eval_shape(
+                lambda ps, xx, a=r0, b=r1: _apply_range(model, ps, xx, ctx, a, b),
+                [params_list[i] for i in range(r0, r1)],
+                x,
+            )
+        out_struct = x
+        act_packs = [TreePack.of_struct(s, compute_dtype) for s in act_structs]
+        out_pack = TreePack.of_struct(out_struct, compute_dtype)
+        param_max = max(p.total for p in param_packs)
+        act_max = max([p.total for p in act_packs] + [out_pack.total])
+        return cls(model, ranges, param_packs, act_packs, out_pack, param_max, act_max)
+
+    # ---- parameter buffers ----
+
+    def pack_params(self, params_list) -> jax.Array:
+        """[S, param_max] fp32 buffer (row s = stage s's flat params)."""
+        rows = []
+        for (r0, r1), pk in zip(self.ranges, self.param_packs):
+            rows.append(pad_to(pk.pack([params_list[i] for i in range(r0, r1)]), self.param_max))
+        return jnp.stack(rows)
+
+    def unpack_params(self, buf: jax.Array) -> List[Any]:
+        """Inverse of pack_params (host-side, for checkpoint/eval)."""
+        out: List[Any] = []
+        for s, ((r0, r1), pk) in enumerate(zip(self.ranges, self.param_packs)):
+            sub = pk.unpack(buf[s, : pk.total])
+            out.extend(sub)
+        return out
+
+    def stage_apply(self, s: int, flat_params, act, ctx: ApplyCtx):
+        """Apply stage s's cell range to an activation pytree."""
+        r0, r1 = self.ranges[s]
+        pk = self.param_packs[s]
+        params = pk.unpack(lax_slice(flat_params, 0, pk.total))
+        return _apply_range(self.model, params, act, ctx, r0, r1)
+
+
+def _apply_range(model: CellModel, sub_params, x, ctx: ApplyCtx, r0: int, r1: int):
+    """Run cells [r0, r1) with a stage-local (0-based) params list."""
+    for i in range(r0, r1):
+        x = model.cells[i].apply(sub_params[i - r0], x, ctx)
+    return x
+
+
+def _treepack_of_struct(struct, dtype) -> TreePack:
+    leaves, treedef = jax.tree.flatten(struct)
+    shapes = tuple(tuple(map(int, l.shape)) for l in leaves)
+    dtypes = tuple(dtype for _ in leaves)
+    sizes = tuple(int(np.prod(s)) if s else 1 for s in shapes)
+    return TreePack(treedef, shapes, dtypes, sizes)
+
+
+TreePack.of_struct = staticmethod(_treepack_of_struct)

@@ -1,0 +1,198 @@
+"""The SPMD pipeline engine (LP + GPipe PP), single jitted program.
+
+Reference behaviour being re-expressed: ``train_model`` runs per-rank
+processes exchanging activations/grads with tagged MPI send/recv and loops
+micro-batch "parts" all-forward-then-all-backward
+(``mp_pipeline.py:294-432``, ``:509-534``).  Here the whole schedule is ONE
+``lax.scan`` inside ONE ``shard_map``:
+
+- Each device holds its stage's flat parameter row ([S, Pmax] sharded over
+  ``stage``) and runs its stage via ``lax.switch`` (stages are heterogeneous;
+  branch s statically unpacks stage s's params/activations).
+- The activation buffer rotates stage→stage+1 with one non-wrapping
+  ``ppermute`` per tick; stage 0 overwrites its buffer with the next
+  micro-batch injection.
+- T = parts + S - 1 ticks fill and drain the pipe (GPipe).  Bubble ticks
+  compute on don't-care data and are masked out of the loss — the same
+  wall-clock the reference's idle bubbles cost, with no control-flow
+  divergence in the compiled program.
+- **The backward pass is jax.grad of the scan.**  AD transposes the forward
+  ppermute into the reverse-direction cotangent ppermute (the reference's
+  explicit grad send/recv chain, mp_pipeline.py:365-432) and replays ticks in
+  reverse order — all-forward-then-all-backward falls out, with per-stage
+  rematerialisation (jax.checkpoint) bounding activation memory exactly like
+  GPipe.
+
+No recv buffers, no tags, no GEMS_INVERSE rank mirroring — placement is the
+mesh, ordering is dataflow.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mpi4dl_tpu.cells import CellModel
+from mpi4dl_tpu.layer_ctx import ApplyCtx
+from mpi4dl_tpu.parallel.partition import StagePartition, lax_slice, pad_to
+from mpi4dl_tpu.train import Optimizer, accuracy, cross_entropy
+
+
+@dataclasses.dataclass
+class PipelineState:
+    """Flat training state: [S, Pmax] param buffer + optimizer state."""
+
+    param_buf: jax.Array
+    opt_state: Any
+    step: jax.Array
+
+
+jax.tree_util.register_dataclass(
+    PipelineState, data_fields=["param_buf", "opt_state", "step"], meta_fields=[]
+)
+
+
+def make_pipeline_train_step(
+    part: StagePartition,
+    optimizer: Optimizer,
+    mesh: Mesh,
+    parts: int,
+    compute_dtype=jnp.float32,
+    remat: bool = True,
+    from_probs: bool = False,
+    with_data_axis: bool = False,
+    loss_scale: float = 1.0,
+):
+    """Build `(PipelineState, x, labels) -> (PipelineState, metrics)`.
+
+    x: [B, H, W, C] global batch (B = parts * microbatch); labels: [B].
+    """
+    S = part.num_stages
+    Pn = parts
+    T = Pn + S - 1
+    ctx = ApplyCtx(train=True)
+    amax = part.act_max
+
+    def stage_branch(s: int):
+        pk_in = part.act_packs[s]
+        out_pk = part.act_packs[s + 1] if s + 1 < S else part.out_pack
+
+        def fn(flat_params, buf):
+            act = pk_in.unpack(lax_slice(buf, 0, pk_in.total), dtype=compute_dtype)
+            y = part.stage_apply(s, flat_params, act, ctx)
+            return pad_to(out_pk.pack(y, compute_dtype), amax)
+
+        return jax.checkpoint(fn) if remat else fn
+
+    branches = [stage_branch(s) for s in range(S)]
+
+    grad_axes: Tuple[str, ...] = ("data",) if with_data_axis else ()
+
+    def sharded_step(param_row, opt_state, x, labels):
+        # param_row: [1, Pmax] local stage block; squeeze to [Pmax].
+        flat_params = param_row[0]
+        s_idx = lax.axis_index("stage")
+        mb = x.shape[0] // Pn
+        x_parts = x.reshape(Pn, mb, *x.shape[1:]).astype(compute_dtype)
+        y_parts = labels.reshape(Pn, mb)
+        in_pack0 = part.act_packs[0]
+        logits_n = part.out_pack.total
+        nclass = part.out_pack.shapes[0][-1]
+        is_last = s_idx == S - 1
+
+        def loss_and_metrics(flat_params):
+            def tick(carry, t):
+                buf, loss_acc, acc_acc = carry
+                p_in = jnp.clip(t, 0, Pn - 1)
+                inj = pad_to(
+                    in_pack0.pack(
+                        lax.dynamic_index_in_dim(x_parts, p_in, keepdims=False),
+                        compute_dtype,
+                    ),
+                    amax,
+                )
+                buf = jnp.where(s_idx == 0, inj, buf)
+                y = lax.switch(s_idx, branches, flat_params, buf)
+                # Last stage: loss for part p = t - (S-1) when in range.
+                p_out = t - (S - 1)
+                valid = (p_out >= 0) & (p_out < Pn) & is_last
+                logits = lax_slice(y, 0, logits_n).reshape(mb, nclass)
+                lbl = lax.dynamic_index_in_dim(
+                    y_parts, jnp.clip(p_out, 0, Pn - 1), keepdims=False
+                )
+                l = cross_entropy(logits, lbl, from_probs)
+                a = accuracy(logits, lbl)
+                loss_acc = loss_acc + jnp.where(valid, l, 0.0)
+                acc_acc = acc_acc + jnp.where(valid, a, 0.0)
+                # Hand activations to the next stage (non-wrap: stage 0's
+                # stale recv is overwritten by injection next tick).
+                buf = lax.ppermute(y, "stage", [(i, i + 1) for i in range(S - 1)])
+                return (buf, loss_acc, acc_acc), None
+
+            # Initial carries must be marked varying over the axes the loop
+            # makes them vary on, or shard_map's AD produces wrong collective
+            # transposes (grads scaled by axis size).
+            vary = ("stage",) + grad_axes
+
+            def v(t):
+                return lax.pcast(t, vary, to="varying")
+
+            buf0 = v(jnp.zeros((amax,), compute_dtype))
+            (buf, loss_acc, acc_acc), _ = lax.scan(
+                tick, (buf0, v(jnp.zeros(())), v(jnp.zeros(()))), jnp.arange(T)
+            )
+            # Only the last stage accumulated; psum broadcasts to all stages
+            # (and sums over data-parallel groups' mean below).
+            loss = lax.psum(loss_acc, "stage") / Pn
+            acc = lax.psum(acc_acc, "stage") / Pn
+            if grad_axes:
+                loss = lax.pmean(loss, grad_axes)
+                acc = lax.pmean(acc, grad_axes)
+            return loss * loss_scale, acc
+
+        (loss, acc), grads = jax.value_and_grad(loss_and_metrics, has_aux=True)(
+            flat_params
+        )
+        if loss_scale != 1.0:
+            grads = grads / loss_scale
+            loss = loss / loss_scale
+        if grad_axes:
+            grads = lax.pmean(grads, grad_axes)
+        new_flat, new_opt = optimizer.update(flat_params, grads, opt_state)
+        return new_flat[None], new_opt, {"loss": loss, "accuracy": acc}
+
+    pspec = P("stage", None)
+    dspec = P("data") if with_data_axis else P()
+    smapped = shard_map(
+        sharded_step,
+        mesh=mesh,
+        in_specs=(pspec, pspec, dspec, dspec),
+        out_specs=(pspec, pspec, P()),
+    )
+
+    @jax.jit
+    def step(state: PipelineState, x, labels):
+        pb, opt, metrics = smapped(state.param_buf, state.opt_state, x, labels)
+        return PipelineState(pb, opt, state.step + 1), metrics
+
+    return step
+
+
+def init_pipeline_state(
+    part: StagePartition, params_list, optimizer: Optimizer, mesh: Mesh
+) -> PipelineState:
+    """Pack params into the stage-sharded buffer and init the optimizer
+    stage-locally (opt state shares the buffer's sharding)."""
+    buf = part.pack_params(params_list)
+    sharding = NamedSharding(mesh, P("stage", None))
+    buf = jax.device_put(buf, sharding)
+    opt_state = jax.tree.map(
+        lambda z: jax.device_put(z, sharding), optimizer.init(buf)
+    )
+    return PipelineState(buf, opt_state, jnp.zeros((), jnp.int32))

@@ -244,11 +244,19 @@ def make_spatial_train_step(
     x_spec = spatial_partition_spec(sp, data=with_data_axis)
     y_spec = P("data") if with_data_axis else P()
 
+    def global_loss_fn(p, xx, yy):
+        # pmean over the tile axes makes the differentiated scalar the GLOBAL
+        # loss; with shard_map's varying-axes tracking, each device's gradient
+        # of it is then the complete gradient (the all_gather junction's
+        # adjoint performs the cross-tile summation).  See tests/test_spatial.
+        loss, aux = loss_fn(p, xx, yy)
+        return lax.pmean(loss, grad_axes), aux
+
     def sharded_step(params, opt_state, x, labels):
         def grads_for(p, xx, yy):
-            (loss, (logits, yy_used)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                p, xx.astype(compute_dtype), yy
-            )
+            (loss, (logits, yy_used)), grads = jax.value_and_grad(
+                global_loss_fn, has_aux=True
+            )(p, xx.astype(compute_dtype), yy)
             return loss, accuracy(logits, yy_used), grads
 
         if parts == 1:
@@ -256,7 +264,10 @@ def make_spatial_train_step(
         else:
             mb_x = x.reshape(parts, x.shape[0] // parts, *x.shape[1:])
             mb_y = labels.reshape(parts, labels.shape[0] // parts)
-            zero = jax.tree.map(jnp.zeros_like, params)
+            # Mark accumulators varying over the tile axes (see pipeline.py —
+            # required for correct collective transposes under shard_map AD).
+            v = lambda t: lax.pcast(t, grad_axes, to="varying")
+            zero = jax.tree.map(lambda p: v(jnp.zeros_like(p)), params)
 
             def body(carry, mb):
                 g_acc, l_acc, a_acc = carry
@@ -268,7 +279,7 @@ def make_spatial_train_step(
                 ), None
 
             (grads, loss, acc), _ = lax.scan(
-                body, (zero, jnp.zeros(()), jnp.zeros(())), (mb_x, mb_y)
+                body, (zero, v(jnp.zeros(())), v(jnp.zeros(()))), (mb_x, mb_y)
             )
             grads = jax.tree.map(lambda g: g / parts, grads)
             loss, acc = loss / parts, acc / parts
@@ -288,7 +299,6 @@ def make_spatial_train_step(
         mesh=mesh,
         in_specs=(P(), P(), x_spec, y_spec),
         out_specs=(P(), P(), P()),
-        check_vma=False,
     )
 
     @jax.jit
