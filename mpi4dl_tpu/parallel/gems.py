@@ -1,0 +1,194 @@
+"""GEMS: bidirectional ("memory-aware") model parallelism, TPU-native.
+
+Reference behaviour (``src/torchgems/gems_master.py``,
+``train_spatial_master.py``): a second weight replica is laid out on the SAME
+devices with stage order reversed (rank i hosts stage S-1-i), and each step
+trains batch A through the forward chain and batch B through the reversed
+chain, filling the pipeline bubbles in both directions; the two replicas'
+gradients are combined by a mirrored-pair allreduce (``comm.py:460-504``) or
+overlapped flat-buffer exchanges (MASTER-OPT,
+``train_spatial_master.py:229-455``).
+
+TPU-native re-design (this module):
+
+- There is ONE set of weights: the [S, Pmax] stage-sharded flat buffer.  The
+  reverse replica on device d is ``mirror = ppermute(buf, stage, i→S-1-i)`` —
+  one ICI permute per step instead of a second resident optimizer state +
+  param exchange protocol.  (SURVEY §7.6 flags this elimination as the thing
+  to explore; it also makes MASTER-OPT moot: the replicas cannot diverge.)
+- Both streams run in the SAME ``lax.scan``: buffer A rotates d→d+1, buffer B
+  rotates d→d-1; device d applies stage d to A and stage S-1-d to B each tick
+  (two switch branches back-to-back — XLA interleaves them, which is exactly
+  the bidirectional bubble-filling).
+- The mirrored-pair gradient combine is *free*: batch B's loss reaches the
+  true weights through the mirror ppermute, so its adjoint routes the reverse
+  replica's gradients back to their home stages automatically.
+- ``times`` (reference ``--times`` replication, gems_master.py:87-102)
+  processes `times` A/B pairs per step, accumulating gradients, then updates
+  once — 2·times micro-batch groups per optimizer step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from mpi4dl_tpu.layer_ctx import ApplyCtx
+from mpi4dl_tpu.parallel.partition import StagePartition, lax_slice, pad_to
+from mpi4dl_tpu.parallel.pipeline import PipelineState
+from mpi4dl_tpu.train import Optimizer, accuracy, cross_entropy
+
+
+def make_gems_train_step(
+    part: StagePartition,
+    optimizer: Optimizer,
+    mesh: Mesh,
+    parts: int,
+    times: int = 1,
+    compute_dtype=jnp.float32,
+    remat: bool = True,
+    from_probs: bool = False,
+    with_data_axis: bool = False,
+):
+    """Build the GEMS step: x is [2 * times * parts * mb, H, W, C]; the first
+    half of each pair flows forward, the second backward."""
+    S = part.num_stages
+    Pn = parts
+    T = Pn + S - 1
+    ctx = ApplyCtx(train=True)
+    amax = part.act_max
+    mirror_perm = [(i, S - 1 - i) for i in range(S)]
+    fwd_perm = [(i, i + 1) for i in range(S - 1)]
+    bwd_perm = [(i + 1, i) for i in range(S - 1)]
+    grad_axes: Tuple[str, ...] = ("data",) if with_data_axis else ()
+
+    def stage_branch(s: int):
+        pk_in = part.act_packs[s]
+        out_pk = part.act_packs[s + 1] if s + 1 < S else part.out_pack
+
+        def fn(flat_params, buf):
+            act = pk_in.unpack(lax_slice(buf, 0, pk_in.total), dtype=compute_dtype)
+            y = part.stage_apply(s, flat_params, act, ctx)
+            return pad_to(out_pk.pack(y, compute_dtype), amax)
+
+        return jax.checkpoint(fn) if remat else fn
+
+    branches = [stage_branch(s) for s in range(S)]
+
+    def sharded_step(param_row, opt_state, x, labels):
+        flat_params = param_row[0]
+        d = lax.axis_index("stage")
+        groups = 2 * times
+        mb = x.shape[0] // (groups * Pn)
+        # [times, 2, parts, mb, ...]
+        xs = x.reshape(times, 2, Pn, mb, *x.shape[1:]).astype(compute_dtype)
+        ys = labels.reshape(times, 2, Pn, mb)
+        in_pack0 = part.act_packs[0]
+        logits_n = part.out_pack.total
+        nclass = part.out_pack.shapes[0][-1]
+        vary = ("stage",) + grad_axes
+        v = lambda t: lax.pcast(t, vary, to="varying")
+
+        def loss_and_metrics(flat_params):
+            # The reverse replica's params: device d gets stage S-1-d's row.
+            mirror_params = lax.ppermute(flat_params, "stage", mirror_perm)
+
+            def one_pair(carry, pair):
+                loss_in, acc_in = carry
+                xa, ya_lbl = pair[0][0], pair[1][0]
+                xb, yb_lbl = pair[0][1], pair[1][1]
+
+                def tick(c, t):
+                    bufA, bufB, l_acc, a_acc = c
+                    p_in = jnp.clip(t, 0, Pn - 1)
+                    injA = pad_to(
+                        in_pack0.pack(
+                            lax.dynamic_index_in_dim(xa, p_in, keepdims=False),
+                            compute_dtype,
+                        ),
+                        amax,
+                    )
+                    injB = pad_to(
+                        in_pack0.pack(
+                            lax.dynamic_index_in_dim(xb, p_in, keepdims=False),
+                            compute_dtype,
+                        ),
+                        amax,
+                    )
+                    bufA = jnp.where(d == 0, injA, bufA)
+                    bufB = jnp.where(d == S - 1, injB, bufB)
+                    yA = lax.switch(d, branches, flat_params, bufA)
+                    yB = lax.switch(S - 1 - d, branches, mirror_params, bufB)
+                    p_out = t - (S - 1)
+                    in_range = (p_out >= 0) & (p_out < Pn)
+                    lblA = lax.dynamic_index_in_dim(
+                        ya_lbl, jnp.clip(p_out, 0, Pn - 1), keepdims=False
+                    )
+                    lblB = lax.dynamic_index_in_dim(
+                        yb_lbl, jnp.clip(p_out, 0, Pn - 1), keepdims=False
+                    )
+                    logitsA = lax_slice(yA, 0, logits_n).reshape(mb, nclass)
+                    logitsB = lax_slice(yB, 0, logits_n).reshape(mb, nclass)
+                    validA = in_range & (d == S - 1)
+                    validB = in_range & (d == 0)
+                    l_acc = (
+                        l_acc
+                        + jnp.where(validA, cross_entropy(logitsA, lblA, from_probs), 0.0)
+                        + jnp.where(validB, cross_entropy(logitsB, lblB, from_probs), 0.0)
+                    )
+                    a_acc = (
+                        a_acc
+                        + jnp.where(validA, accuracy(logitsA, lblA), 0.0)
+                        + jnp.where(validB, accuracy(logitsB, lblB), 0.0)
+                    )
+                    bufA = lax.ppermute(yA, "stage", fwd_perm)
+                    bufB = lax.ppermute(yB, "stage", bwd_perm)
+                    return (bufA, bufB, l_acc, a_acc), None
+
+                init = (
+                    v(jnp.zeros((amax,), compute_dtype)),
+                    v(jnp.zeros((amax,), compute_dtype)),
+                    v(jnp.zeros(())),
+                    v(jnp.zeros(())),
+                )
+                (_, _, l_acc, a_acc), _ = lax.scan(tick, init, jnp.arange(T))
+                return (loss_in + l_acc, acc_in + a_acc), None
+
+            (loss_acc, acc_acc), _ = lax.scan(
+                one_pair, (v(jnp.zeros(())), v(jnp.zeros(()))), (xs, ys)
+            )
+            denom = 2 * times * Pn
+            loss = lax.psum(loss_acc, "stage") / denom
+            acc = lax.psum(acc_acc, "stage") / denom
+            if grad_axes:
+                loss = lax.pmean(loss, grad_axes)
+                acc = lax.pmean(acc, grad_axes)
+            return loss, acc
+
+        (loss, acc), grads = jax.value_and_grad(loss_and_metrics, has_aux=True)(
+            flat_params
+        )
+        if grad_axes:
+            grads = lax.pmean(grads, grad_axes)
+        new_flat, new_opt = optimizer.update(flat_params, grads, opt_state)
+        return new_flat[None], new_opt, {"loss": loss, "accuracy": acc}
+
+    pspec = P("stage", None)
+    dspec = P("data") if with_data_axis else P()
+    smapped = shard_map(
+        sharded_step,
+        mesh=mesh,
+        in_specs=(pspec, pspec, dspec, dspec),
+        out_specs=(pspec, pspec, P()),
+    )
+
+    @jax.jit
+    def step(state: PipelineState, x, labels):
+        pb, opt, metrics = smapped(state.param_buf, state.opt_state, x, labels)
+        return PipelineState(pb, opt, state.step + 1), metrics
+
+    return step
