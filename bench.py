@@ -1,0 +1,76 @@
+"""Headline benchmark — prints ONE JSON line.
+
+Metric: AmoebaNet-D training throughput (images/sec) on one chip at the
+reference's flagship 1024x1024 resolution, batch size 1 (the configuration of
+the reference's published charts, BASELINE.md: best bs1 result at 1024^2 is
+~2.1 img/s for SP square + halo-D2 across 5 GPUs).  ``vs_baseline`` is
+images/sec divided by that 2.1 img/s reference number.
+
+On a CPU host (no TPU attached) the benchmark downsizes so it still completes;
+the driver runs it on real TPU hardware.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from mpi4dl_tpu.models.amoebanet import amoebanetd
+from mpi4dl_tpu.train import Optimizer, TrainState, make_train_step
+
+BASELINE_IMG_PER_SEC = 2.1  # reference: AmoebaNet-D 1024^2 bs1, SP square + D2, 5 GPUs
+
+
+def main() -> None:
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+
+    if on_tpu:
+        image_size, num_layers, num_filters, batch = 1024, 18, 416, 1
+        warmup, iters = 2, 8
+    else:  # smoke mode for CPU-only environments
+        image_size, num_layers, num_filters, batch = 128, 3, 64, 1
+        warmup, iters = 1, 3
+
+    model = amoebanetd(
+        (batch, image_size, image_size, 3),
+        num_classes=1000,
+        num_layers=num_layers,
+        num_filters=num_filters,
+    )
+    params, _ = model.init(jax.random.key(0))
+    opt = Optimizer("sgd", lr=0.001)
+    step = make_train_step(model, opt, compute_dtype=jnp.bfloat16)
+    state = TrainState.create(params, opt)
+
+    x = jax.random.normal(jax.random.key(1), (batch, image_size, image_size, 3))
+    y = jnp.zeros((batch,), jnp.int32)
+
+    for _ in range(warmup):
+        state, metrics = step(state, x, y)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, x, y)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    img_per_sec = batch * iters / dt
+    out = {
+        "metric": f"amoebanetd_{image_size}px_bs{batch}_train_img_per_sec_per_chip",
+        "value": round(img_per_sec, 4),
+        "unit": "images/sec",
+        # Only the TPU run at the reference resolution is comparable to the
+        # reference's 2.1 img/s; the CPU smoke config reports 0.
+        "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 4) if on_tpu else 0.0,
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
