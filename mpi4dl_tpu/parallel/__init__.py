@@ -3,9 +3,32 @@ from mpi4dl_tpu.parallel.spatial import (
     scatter_batch_over_tiles,
     apply_spatial_model,
 )
+from mpi4dl_tpu.parallel.partition import StagePartition, TreePack
+from mpi4dl_tpu.parallel.pipeline import (
+    PipelineState,
+    init_pipeline_state,
+    make_pipeline_train_step,
+)
+from mpi4dl_tpu.parallel.gems import make_gems_train_step
+from mpi4dl_tpu.parallel.sp_pipeline import (
+    SPPipeline,
+    SPPipelineState,
+    init_sp_pipeline_state,
+    make_sp_pipeline_train_step,
+)
 
 __all__ = [
     "gather_spatial",
     "scatter_batch_over_tiles",
     "apply_spatial_model",
+    "StagePartition",
+    "TreePack",
+    "PipelineState",
+    "init_pipeline_state",
+    "make_pipeline_train_step",
+    "make_gems_train_step",
+    "SPPipeline",
+    "SPPipelineState",
+    "init_sp_pipeline_state",
+    "make_sp_pipeline_train_step",
 ]

@@ -40,6 +40,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from mpi4dl_tpu.layer_ctx import ApplyCtx
 from mpi4dl_tpu.parallel.partition import StagePartition, lax_slice, pad_to
 from mpi4dl_tpu.parallel.pipeline import PipelineState
+from mpi4dl_tpu.parallel.stage_common import make_stage_branches
 from mpi4dl_tpu.train import Optimizer, accuracy, cross_entropy
 
 
@@ -66,18 +67,7 @@ def make_gems_train_step(
     bwd_perm = [(i + 1, i) for i in range(S - 1)]
     grad_axes: Tuple[str, ...] = ("data",) if with_data_axis else ()
 
-    def stage_branch(s: int):
-        pk_in = part.act_packs[s]
-        out_pk = part.act_packs[s + 1] if s + 1 < S else part.out_pack
-
-        def fn(flat_params, buf):
-            act = pk_in.unpack(lax_slice(buf, 0, pk_in.total), dtype=compute_dtype)
-            y = part.stage_apply(s, flat_params, act, ctx)
-            return pad_to(out_pk.pack(y, compute_dtype), amax)
-
-        return jax.checkpoint(fn) if remat else fn
-
-    branches = [stage_branch(s) for s in range(S)]
+    branches = make_stage_branches(part, ctx, compute_dtype, remat)
 
     def sharded_step(param_row, opt_state, x, labels):
         flat_params = param_row[0]

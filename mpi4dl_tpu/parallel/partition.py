@@ -110,10 +110,14 @@ class StagePartition:
         model: CellModel,
         params_list: Sequence[Any],
         split_size: int,
-        microbatch_shape: Tuple[int, ...],
+        microbatch_shape: Any,
         balance: Optional[Sequence[int]] = None,
         compute_dtype=jnp.float32,
     ) -> "StagePartition":
+        """``microbatch_shape`` is either a plain shape tuple or a pytree of
+        ``jax.ShapeDtypeStruct`` (tuple activations entering stage 0 — the
+        SP→LP junction of sp_pipeline.py hands tail stages AmoebaNet's
+        (x, skip) state)."""
         ranges = split_even(len(model.cells), split_size, balance)
         param_packs = [
             TreePack.of([params_list[i] for i in range(r0, r1)]) for r0, r1 in ranges
@@ -121,7 +125,12 @@ class StagePartition:
         # Boundary activation structures via eval_shape chain (the reference's
         # two-phase shape probe, mp_pipeline.py:126-168, for free).
         act_structs = []
-        x = jax.ShapeDtypeStruct(microbatch_shape, compute_dtype)
+        if isinstance(microbatch_shape, tuple) and all(
+            isinstance(d, int) for d in microbatch_shape
+        ):
+            x = jax.ShapeDtypeStruct(microbatch_shape, compute_dtype)
+        else:
+            x = microbatch_shape
         ctx = ApplyCtx(train=True)
         for s, (r0, r1) in enumerate(ranges):
             act_structs.append(x)

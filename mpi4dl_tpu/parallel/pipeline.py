@@ -30,18 +30,17 @@ mesh, ordering is dataflow.
 from __future__ import annotations
 
 import dataclasses
-import functools
-from typing import Any, Optional, Tuple
+from typing import Any, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from mpi4dl_tpu.cells import CellModel
 from mpi4dl_tpu.layer_ctx import ApplyCtx
-from mpi4dl_tpu.parallel.partition import StagePartition, lax_slice, pad_to
-from mpi4dl_tpu.train import Optimizer, accuracy, cross_entropy
+from mpi4dl_tpu.parallel.partition import StagePartition
+from mpi4dl_tpu.parallel.stage_common import gpipe_scan, make_stage_branches
+from mpi4dl_tpu.train import Optimizer
 
 
 @dataclasses.dataclass
@@ -75,77 +74,25 @@ def make_pipeline_train_step(
     """
     S = part.num_stages
     Pn = parts
-    T = Pn + S - 1
     ctx = ApplyCtx(train=True)
-    amax = part.act_max
 
-    def stage_branch(s: int):
-        pk_in = part.act_packs[s]
-        out_pk = part.act_packs[s + 1] if s + 1 < S else part.out_pack
-
-        def fn(flat_params, buf):
-            act = pk_in.unpack(lax_slice(buf, 0, pk_in.total), dtype=compute_dtype)
-            y = part.stage_apply(s, flat_params, act, ctx)
-            return pad_to(out_pk.pack(y, compute_dtype), amax)
-
-        return jax.checkpoint(fn) if remat else fn
-
-    branches = [stage_branch(s) for s in range(S)]
+    branches = make_stage_branches(part, ctx, compute_dtype, remat)
 
     grad_axes: Tuple[str, ...] = ("data",) if with_data_axis else ()
 
     def sharded_step(param_row, opt_state, x, labels):
         # param_row: [1, Pmax] local stage block; squeeze to [Pmax].
         flat_params = param_row[0]
-        s_idx = lax.axis_index("stage")
         mb = x.shape[0] // Pn
         x_parts = x.reshape(Pn, mb, *x.shape[1:]).astype(compute_dtype)
         y_parts = labels.reshape(Pn, mb)
-        in_pack0 = part.act_packs[0]
-        logits_n = part.out_pack.total
-        nclass = part.out_pack.shapes[0][-1]
-        is_last = s_idx == S - 1
 
         def loss_and_metrics(flat_params):
-            def tick(carry, t):
-                buf, loss_acc, acc_acc = carry
-                p_in = jnp.clip(t, 0, Pn - 1)
-                inj = pad_to(
-                    in_pack0.pack(
-                        lax.dynamic_index_in_dim(x_parts, p_in, keepdims=False),
-                        compute_dtype,
-                    ),
-                    amax,
-                )
-                buf = jnp.where(s_idx == 0, inj, buf)
-                y = lax.switch(s_idx, branches, flat_params, buf)
-                # Last stage: loss for part p = t - (S-1) when in range.
-                p_out = t - (S - 1)
-                valid = (p_out >= 0) & (p_out < Pn) & is_last
-                logits = lax_slice(y, 0, logits_n).reshape(mb, nclass)
-                lbl = lax.dynamic_index_in_dim(
-                    y_parts, jnp.clip(p_out, 0, Pn - 1), keepdims=False
-                )
-                l = cross_entropy(logits, lbl, from_probs)
-                a = accuracy(logits, lbl)
-                loss_acc = loss_acc + jnp.where(valid, l, 0.0)
-                acc_acc = acc_acc + jnp.where(valid, a, 0.0)
-                # Hand activations to the next stage (non-wrap: stage 0's
-                # stale recv is overwritten by injection next tick).
-                buf = lax.ppermute(y, "stage", [(i, i + 1) for i in range(S - 1)])
-                return (buf, loss_acc, acc_acc), None
-
-            # Initial carries must be marked varying over the axes the loop
-            # makes them vary on, or shard_map's AD produces wrong collective
-            # transposes (grads scaled by axis size).
-            vary = ("stage",) + grad_axes
-
-            def v(t):
-                return lax.pcast(t, vary, to="varying")
-
-            buf0 = v(jnp.zeros((amax,), compute_dtype))
-            (buf, loss_acc, acc_acc), _ = lax.scan(
-                tick, (buf0, v(jnp.zeros(())), v(jnp.zeros(()))), jnp.arange(T)
+            loss_acc, acc_acc = gpipe_scan(
+                part, branches, flat_params, x_parts, y_parts,
+                vary_axes=("stage",) + grad_axes,
+                from_probs=from_probs,
+                compute_dtype=compute_dtype,
             )
             # Only the last stage accumulated; psum broadcasts to all stages
             # (and sums over data-parallel groups' mean below).

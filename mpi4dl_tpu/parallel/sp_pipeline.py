@@ -1,0 +1,297 @@
+"""SP x PP: spatial parallelism composed with the pipeline engine.
+
+Reference behaviour being re-expressed: ``train_model_spatial``
+(``src/torchgems/train_spatial.py:293-1458``) runs the first ``spatial_size``
+pipeline split(s) spread over ``num_spatial_parts`` tile ranks (halo-exchange
+convs), then hands tiles to the layer-parallel tail — via a joint-rank
+gather + concat mosaic (``:690-721``, ``:1083-1188``) or the scatter/gather
+LOCAL_DP_LP junction (``:809-1028``) — and pipelines micro-batch parts
+through the tail ranks.
+
+TPU-native re-design (one jitted SPMD program over mesh (data, stage, sph,
+spw); every collective is uniform — see stage_common.py for why stage
+branches must be pure compute):
+
+- **SP phase**: the ``stage`` axis is data-parallel over the batch.  Every
+  stage block takes its 1/S chunk of the batch and runs the spatial region
+  tiled over (sph, spw) with halo exchanges.  Where the reference idles the
+  tail GPUs during spatial compute (and the tile GPUs during tail compute),
+  here every device computes the spatial region on distinct images —
+  S x more spatial throughput from the same mesh.
+- **Junction**: ``all_gather`` over the tile axes (the mosaic merge), then
+  either replicate the tail per tile coordinate (junction='gather', the
+  reference's plain SP→LP handoff) or batch-split over tile coordinates
+  (junction='batch_split', the reference's LOCAL_DP_LP); finally an
+  ``all_gather`` over ``stage`` lines junction activations up in micro-batch
+  injection order.
+- **PP phase**: the shared GPipe tick scan (stage_common.gpipe_scan) over the
+  tail cells.  The backward pass of BOTH phases is one jax.grad through the
+  whole program: the junction gathers transpose into the tile/stage scatter
+  of cotangents the reference implements by hand.
+
+Gradient combine (derived from the collective transposes; validated exactly
+against single-device SGD in tests/test_sp_pipeline.py):
+
+- tail stage rows: pmean over tile axes (+ data),
+- spatial params (replicated): pmean over ``stage`` and tile axes (+ data) —
+  each device's cotangent of the fully-reduced loss already carries the
+  global psum-broadcast, so combining is an average everywhere (empirically
+  calibrated: a psum over ``stage`` double-counts by exactly S).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mpi4dl_tpu.cells import CellModel
+from mpi4dl_tpu.layer_ctx import ApplyCtx, SpatialCtx
+from mpi4dl_tpu.parallel.partition import StagePartition, TreePack, pad_to
+from mpi4dl_tpu.parallel.spatial import (
+    gather_spatial,
+    scatter_batch_over_tiles,
+    tile_linear_index,
+)
+from mpi4dl_tpu.parallel.stage_common import gpipe_scan, make_stage_branches
+from mpi4dl_tpu.train import Optimizer, spatial_partition_spec
+
+
+@dataclasses.dataclass
+class SPPipeline:
+    """Static partition of a model into a spatial region + pipeline tail."""
+
+    model: CellModel
+    spatial_until: int
+    sp: SpatialCtx
+    sp_pack: TreePack  # spatial-region params, one flat vector
+    tail_part: StagePartition  # pipeline partition of the tail cells
+    junction: str  # 'gather' | 'batch_split'
+    mb_tail: int  # per-device tail micro-batch
+
+    @classmethod
+    def build(
+        cls,
+        model: CellModel,
+        params_list,
+        split_size: int,
+        sp: SpatialCtx,
+        microbatch: int,
+        junction: str = "batch_split",
+        balance=None,
+        compute_dtype=jnp.float32,
+    ) -> "SPPipeline":
+        su = model.spatial_until
+        assert 0 < su < len(model.cells), f"spatial_until={su} must split the model"
+        tiles = sp.grid_h * sp.grid_w
+        # Junction activation structure from abstract evaluation at GLOBAL
+        # shapes (the reference's get_shapes_spatial tile math collapses into
+        # eval_shape + one divide, train_spatial.py:61-238).
+        ctx = ApplyCtx(train=True)
+        jstruct = jax.eval_shape(
+            lambda ps, xx: model.apply(ps, xx, ctx, start=0, stop=su),
+            params_list[:su],
+            jax.ShapeDtypeStruct((microbatch, *model.in_shape[1:]), compute_dtype),
+        )
+        if junction == "batch_split":
+            assert microbatch % tiles == 0, (microbatch, tiles)
+            mb_tail = microbatch // tiles
+        else:
+            mb_tail = microbatch
+        tail_in = jax.tree.map(
+            lambda s: jax.ShapeDtypeStruct((mb_tail, *s.shape[1:]), compute_dtype),
+            jstruct,
+        )
+        tail_model = CellModel(
+            model.cells[su:],
+            model.in_shape,
+            model.num_classes,
+            name=model.name + "_tail",
+        )
+        tail_part = StagePartition.build(
+            tail_model, params_list[su:], split_size, tail_in,
+            balance=balance, compute_dtype=compute_dtype,
+        )
+        sp_pack = TreePack.of(params_list[:su])
+        return cls(model, su, sp, sp_pack, tail_part, junction, mb_tail)
+
+    def pack_spatial(self, params_list) -> jax.Array:
+        return self.sp_pack.pack(params_list[: self.spatial_until])
+
+    def unpack_all(self, sp_vec, tail_buf) -> list:
+        """Reassemble the full params_list (host-side)."""
+        return list(self.sp_pack.unpack(sp_vec)) + self.tail_part.unpack_params(tail_buf)
+
+
+@dataclasses.dataclass
+class SPPipelineState:
+    sp_buf: jax.Array  # [sp_total] replicated
+    tail_buf: jax.Array  # [S, Pmax] stage-sharded
+    opt_sp: Any
+    opt_tail: Any
+    step: jax.Array
+
+
+jax.tree_util.register_dataclass(
+    SPPipelineState,
+    data_fields=["sp_buf", "tail_buf", "opt_sp", "opt_tail", "step"],
+    meta_fields=[],
+)
+
+
+def init_sp_pipeline_state(
+    spp: SPPipeline, params_list, optimizer: Optimizer, mesh: Mesh
+) -> SPPipelineState:
+    sp_buf = jax.device_put(
+        spp.pack_spatial(params_list), NamedSharding(mesh, P())
+    )
+    tail_sharding = NamedSharding(mesh, P("stage", None))
+    tail_buf = jax.device_put(spp.tail_part.pack_params(params_list[spp.spatial_until:]),
+                              tail_sharding)
+    opt_sp = optimizer.init(sp_buf)
+    opt_tail = jax.tree.map(
+        lambda z: jax.device_put(z, tail_sharding), optimizer.init(tail_buf)
+    )
+    return SPPipelineState(sp_buf, tail_buf, opt_sp, opt_tail, jnp.zeros((), jnp.int32))
+
+
+def make_sp_pipeline_train_step(
+    spp: SPPipeline,
+    optimizer: Optimizer,
+    mesh: Mesh,
+    parts: int,
+    compute_dtype=jnp.float32,
+    remat: bool = True,
+    from_probs: bool = False,
+    with_data_axis: bool = False,
+):
+    """Build `(SPPipelineState, x, labels) -> (SPPipelineState, metrics)`.
+
+    x: [B, H, W, C] global batch per data replica group; B = parts * microbatch.
+    Constraints: B % S == 0 (stage blocks take equal chunks) and, for
+    junction='batch_split', microbatch % tiles == 0.
+    """
+    sp = spp.sp
+    part = spp.tail_part
+    S = part.num_stages
+    Pn = parts
+    su = spp.spatial_until
+    tiles = sp.grid_h * sp.grid_w
+    tile_axes = tuple(a for a in (sp.axis_h, sp.axis_w) if a)
+    grad_axes: Tuple[str, ...] = ("data",) if with_data_axis else ()
+    sp_ctx = ApplyCtx(train=True, spatial=sp)
+    tail_ctx = ApplyCtx(train=True)
+
+    branches = make_stage_branches(part, tail_ctx, compute_dtype, remat)
+
+    def phase1(sp_flat, x_tile):
+        """Spatial region on this device's (stage-chunk, tile): returns the
+        tail injection pytree [Pn, mb_tail, ...] in gathered batch order."""
+        B = x_tile.shape[0]
+        chunk = B // S
+        s_idx = lax.axis_index("stage")
+        xs = lax.dynamic_slice_in_dim(x_tile, s_idx * chunk, chunk, axis=0)
+        params_sp = spp.sp_pack.unpack(sp_flat)
+
+        def region(ps, xx):
+            act = spp.model.apply(ps, xx, sp_ctx, start=0, stop=su)
+            return act
+
+        if remat:
+            region = jax.checkpoint(region)
+        act = region(params_sp, xs.astype(compute_dtype))
+        # Junction: mosaic-merge tiles; batch-split for LOCAL_DP_LP.
+        act = gather_spatial(act, sp)
+        if spp.junction == "batch_split":
+            act = scatter_batch_over_tiles(act, sp)
+        # Line all stage chunks up in batch order on every device.
+        def g(t):
+            t = lax.all_gather(t, "stage", axis=0, tiled=True)
+            return t.reshape(Pn, spp.mb_tail, *t.shape[1:])
+
+        return jax.tree.map(g, act)
+
+    def labels_to_parts(labels):
+        """The same index transform phase1 applies to images (chunk by stage
+        block, tile batch-split, gather) — applied host-side-free to labels."""
+        B = labels.shape[0]
+        chunk = B // S
+        if spp.junction == "batch_split":
+            k = tile_linear_index(sp)
+            lab = labels.reshape(S, tiles, chunk // tiles)
+            lab = lax.dynamic_index_in_dim(lab, k, axis=1, keepdims=False)
+            lab = lab.reshape(-1)
+        else:
+            lab = labels
+        return lab.reshape(Pn, spp.mb_tail)
+
+    def sharded_step(sp_buf, tail_row, opt_sp, opt_tail, x, labels):
+        tail_flat = tail_row[0]
+        y_parts = labels_to_parts(labels)
+
+        def loss_and_metrics(sp_flat, tail_flat):
+            x_parts = phase1(sp_flat, x)
+            loss_acc, acc_acc = gpipe_scan(
+                part, branches, tail_flat, x_parts, y_parts,
+                vary_axes=("stage",) + tile_axes + grad_axes,
+                from_probs=from_probs,
+                compute_dtype=compute_dtype,
+            )
+            loss = lax.psum(loss_acc, "stage") / Pn
+            acc = lax.psum(acc_acc, "stage") / Pn
+            if tile_axes:
+                loss = lax.pmean(loss, tile_axes)
+                acc = lax.pmean(acc, tile_axes)
+            if grad_axes:
+                loss = lax.pmean(loss, grad_axes)
+                acc = lax.pmean(acc, grad_axes)
+            return loss, acc
+
+        (loss, acc), (g_sp, g_tail) = jax.value_and_grad(
+            loss_and_metrics, argnums=(0, 1), has_aux=True
+        )(sp_buf, tail_flat)
+
+        # Collective-transpose bookkeeping (see module docstring):
+        g_sp = lax.pmean(g_sp, "stage")
+        if tile_axes:
+            g_sp = lax.pmean(g_sp, tile_axes)
+            g_tail = lax.pmean(g_tail, tile_axes)
+        if grad_axes:
+            g_sp = lax.pmean(g_sp, grad_axes)
+            g_tail = lax.pmean(g_tail, grad_axes)
+
+        new_sp, new_opt_sp = optimizer.update(sp_buf, g_sp, opt_sp)
+        new_tail, new_opt_tail = optimizer.update(tail_flat, g_tail, opt_tail)
+        return (
+            new_sp,
+            new_tail[None],
+            new_opt_sp,
+            new_opt_tail,
+            {"loss": loss, "accuracy": acc},
+        )
+
+    x_spec = spatial_partition_spec(sp, data=with_data_axis)
+    y_spec = P("data") if with_data_axis else P()
+    tail_spec = P("stage", None)
+    smapped = shard_map(
+        sharded_step,
+        mesh=mesh,
+        in_specs=(P(), tail_spec, P(), tail_spec, x_spec, y_spec),
+        out_specs=(P(), tail_spec, P(), tail_spec, P()),
+    )
+
+    @jax.jit
+    def step(state: SPPipelineState, x, labels):
+        sp_buf, tail_buf, opt_sp, opt_tail, metrics = smapped(
+            state.sp_buf, state.tail_buf, state.opt_sp, state.opt_tail, x, labels
+        )
+        return (
+            SPPipelineState(sp_buf, tail_buf, opt_sp, opt_tail, state.step + 1),
+            metrics,
+        )
+
+    return step

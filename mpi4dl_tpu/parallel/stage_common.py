@@ -1,0 +1,117 @@
+"""Machinery shared by the pipeline / GEMS / SP+PP engines.
+
+Stage branches must be PURE COMPUTE: a collective (ppermute/psum) inside a
+``lax.switch`` branch selected by ``axis_index`` deadlocks, because XLA lowers
+a shard_map collective to ONE instruction whose rendezvous spans every device
+on the axis — devices in other branches never arrive (verified empirically on
+the CPU backend; the TPU lowering has the same cross-module semantics).  All
+collectives — stage handoffs, halo exchanges, junction gathers — therefore
+live at the schedule level, uniformly executed by every device.  This is the
+structural reason the SP region runs as a separate uniform phase in
+``sp_pipeline.py`` rather than inside stage-0's branch.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mpi4dl_tpu.layer_ctx import ApplyCtx
+from mpi4dl_tpu.parallel.partition import StagePartition, lax_slice, pad_to
+from mpi4dl_tpu.train import accuracy, cross_entropy
+
+
+def make_stage_branches(
+    part: StagePartition,
+    ctx: ApplyCtx,
+    compute_dtype,
+    remat: bool,
+) -> List[Callable]:
+    """One pure-compute branch per stage: unpack flat activation → run the
+    stage's cells → pack/pad the output activation (reference per-rank
+    sub-model forward, mp_pipeline.py:434-473)."""
+
+    def stage_branch(s: int):
+        pk_in = part.act_packs[s]
+        out_pk = part.act_packs[s + 1] if s + 1 < part.num_stages else part.out_pack
+
+        def fn(flat_params, buf):
+            act = pk_in.unpack(lax_slice(buf, 0, pk_in.total), dtype=compute_dtype)
+            y = part.stage_apply(s, flat_params, act, ctx)
+            return pad_to(out_pk.pack(y, compute_dtype), part.act_max)
+
+        return jax.checkpoint(fn) if remat else fn
+
+    return [stage_branch(s) for s in range(part.num_stages)]
+
+
+def gpipe_scan(
+    part: StagePartition,
+    branches: List[Callable],
+    flat_params: jax.Array,
+    x_parts: jax.Array,
+    y_parts: jax.Array,
+    *,
+    vary_axes: Tuple[str, ...],
+    from_probs: bool,
+    compute_dtype,
+):
+    """The GPipe tick loop (reference run_step, mp_pipeline.py:509-534).
+
+    x_parts: [Pn, mb, ...] micro-batch inputs of stage 0 (device-local);
+    y_parts: [Pn, mb] labels.  Returns (loss_acc, acc_acc) accumulated ONLY on
+    the last stage's devices over the Pn drained parts — callers psum over
+    'stage' and normalise.  T = Pn + S - 1 ticks; activations advance one
+    stage per tick via a non-wrapping ppermute; the backward pass is the AD
+    transpose of this scan (all-forwards-then-all-backwards falls out).
+    """
+    S = part.num_stages
+    lead = jax.tree.leaves(x_parts)[0]
+    Pn, mb = lead.shape[0], lead.shape[1]
+    T = Pn + S - 1
+    s_idx = lax.axis_index("stage")
+    is_last = s_idx == S - 1
+    in_pack0 = part.act_packs[0]
+    logits_n = part.out_pack.total
+    nclass = part.out_pack.shapes[0][-1]
+    amax = part.act_max
+
+    def tick(carry, t):
+        buf, loss_acc, acc_acc = carry
+        p_in = jnp.clip(t, 0, Pn - 1)
+        xp = jax.tree.map(
+            lambda a: lax.dynamic_index_in_dim(a, p_in, keepdims=False), x_parts
+        )
+        inj = pad_to(in_pack0.pack(xp, compute_dtype), amax)
+        buf = jnp.where(s_idx == 0, inj, buf)
+        y = lax.switch(s_idx, branches, flat_params, buf)
+        # Last stage: loss for part p = t - (S-1) when in range.
+        p_out = t - (S - 1)
+        valid = (p_out >= 0) & (p_out < Pn) & is_last
+        logits = lax_slice(y, 0, logits_n).reshape(mb, nclass)
+        lbl = lax.dynamic_index_in_dim(
+            y_parts, jnp.clip(p_out, 0, Pn - 1), keepdims=False
+        )
+        l = cross_entropy(logits, lbl, from_probs)
+        a = accuracy(logits, lbl)
+        loss_acc = loss_acc + jnp.where(valid, l, 0.0)
+        acc_acc = acc_acc + jnp.where(valid, a, 0.0)
+        # Hand activations to the next stage (non-wrap: stage 0's stale recv
+        # is overwritten by injection next tick).
+        buf = lax.ppermute(y, "stage", [(i, i + 1) for i in range(S - 1)])
+        return (buf, loss_acc, acc_acc), None
+
+    # Initial carries must be marked varying over the axes the loop makes
+    # them vary on, or shard_map's AD produces wrong collective transposes
+    # (grads scaled by axis size).
+    def v(t):
+        return lax.pcast(t, vary_axes, to="varying")
+
+    buf0 = v(jnp.zeros((amax,), compute_dtype))
+    (_, loss_acc, acc_acc), _ = lax.scan(
+        tick, (buf0, v(jnp.zeros(())), v(jnp.zeros(()))), jnp.arange(T)
+    )
+    return loss_acc, acc_acc
