@@ -57,6 +57,11 @@ class LayerCell(Cell):
         return params, shape
 
     def apply(self, params, x, ctx):
+        from mpi4dl_tpu.ops.d2 import maybe_run_d2
+
+        y = maybe_run_d2(self.layers, params, x, ctx)
+        if y is not None:
+            return y
         for p, layer in zip(params, self.layers):
             x = layer.apply(p, x, ctx)
         return x

@@ -40,10 +40,16 @@ class SpatialCtx:
     # reference's behaviour (plain nn.BatchNorm2d inside spatial layers,
     # reference resnet_spatial.py:149-163).
     bn_cross_tile: bool = True
-    # When True, convs/pools do NOT exchange halos per-op; instead the model
-    # runs in "D2" mode where a fused halo block pre-exchanged a larger halo
-    # and ops consume it (shrinking outputs).  See ops/halo.py.
+    # When True, maximal conv runs fuse their halo exchanges: ONE accumulated
+    # exchange at run start, convs run VALID on the sharded dims and consume
+    # the margin (the reference's "Design-2", resnet_spatial_d2.py:651-697 /
+    # amoebanet_d2.py — there implemented as separate model classes; here an
+    # apply-time mode).  See ops/d2.py.
     d2_mode: bool = False
+    # Internal: set by the D2 driver for the layers *inside* a fused run —
+    # the margin is already present, so convs skip their own exchange and run
+    # VALID on the sharded dims.
+    halo_pre_exchanged: bool = False
 
     @property
     def active(self) -> bool:

@@ -114,17 +114,19 @@ class Conv2d(Layer):
         kernel = params["kernel"].astype(x.dtype)
         sp = ctx.spatial
         if sp is not None and sp.active:
-            # Halo-exchange the conv's receptive-field overlap, then VALID conv
-            # in the sharded dims.  Non-sharded dims keep explicit padding.
             sharded_h = bool(sp.axis_h) and sp.grid_h > 1
             sharded_w = bool(sp.axis_w) and sp.grid_w > 1
             halo_h = HaloSpec.symmetric(ph if sharded_h else 0)
             halo_w = HaloSpec.symmetric(pw if sharded_w else 0)
-            if halo_h.lo or halo_w.lo:
+            # Per-conv ("D1") halo exchange of the receptive-field overlap —
+            # skipped inside a D2 fused run (sp.halo_pre_exchanged: the
+            # accumulated margin is already in x); either way the conv then
+            # runs VALID on the sharded dims, consuming ph/pw of margin.
+            if not sp.halo_pre_exchanged and (halo_h.lo or halo_w.lo):
                 x = halo_exchange_2d(
                     x, halo_h, halo_w, sp.axis_h, sp.axis_w, sp.grid_h, sp.grid_w
                 )
-            # A dim that exchanged halos (incl. boundary zeros) needs no more
+            # A dim whose margin came from exchange (or pre-exchange) needs no
             # padding; unsharded dims keep explicit symmetric padding.
             padding = (
                 (0, 0) if halo_h.lo else (ph, ph),

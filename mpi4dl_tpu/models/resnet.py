@@ -98,8 +98,20 @@ class ResBlockV1(Cell):
         return params, s
 
     def apply(self, params, x, ctx: ApplyCtx):
-        y = self.r1.apply(params["r1"], x, ctx)
-        y = self.r2.apply(params["r2"], y, ctx)
+        from mpi4dl_tpu.ops.d2 import maybe_run_d2
+
+        # D2: fuse the main path's two convs into one halo exchange; the
+        # shortcut taps the pre-exchange input (margin 0 on both sides of the
+        # add — the reference's D2 crops instead, resnet_spatial_d2.py:462-480).
+        y = maybe_run_d2(
+            list(self.r1.layers) + list(self.r2.layers),
+            list(params["r1"]) + list(params["r2"]),
+            x,
+            ctx,
+        )
+        if y is None:
+            y = self.r1.apply(params["r1"], x, ctx)
+            y = self.r2.apply(params["r2"], y, ctx)
         if self.r3 is not None:
             x = self.r3.apply(params["r3"], x, ctx)
         return jax.nn.relu(x + y)
@@ -152,9 +164,19 @@ class ResBlockV2(Cell):
         return params, s
 
     def apply(self, params, x, ctx: ApplyCtx):
-        y = self.r1.apply(params["r1"], x, ctx)
-        y = self.r2.apply(params["r2"], y, ctx)
-        y = self.r3.apply(params["r3"], y, ctx)
+        from mpi4dl_tpu.ops.d2 import maybe_run_d2
+
+        # D2: one halo exchange for the whole bottleneck (3x3 + 3x3 + 1x1).
+        y = maybe_run_d2(
+            list(self.r1.layers) + list(self.r2.layers) + list(self.r3.layers),
+            list(params["r1"]) + list(params["r2"]) + list(params["r3"]),
+            x,
+            ctx,
+        )
+        if y is None:
+            y = self.r1.apply(params["r1"], x, ctx)
+            y = self.r2.apply(params["r2"], y, ctx)
+            y = self.r3.apply(params["r3"], y, ctx)
         if self.r4 is not None:
             x = self.r4.apply(params["r4"], x, ctx)
         return x + y

@@ -1,0 +1,115 @@
+"""D2 fused halo exchange: one accumulated exchange per conv run.
+
+The reference's "Design-2" replaces per-conv halo exchange with one larger
+exchange per block of ``fused_layers`` convs, the convs then running halo-free
+and shrinking the tile (``src/models/resnet_spatial_d2.py:416-460``,
+accumulated-halo formulas ``:651-697``); its charts show ~1.7-2x throughput
+from this at 1024-2048 px (BASELINE.md).  The reference implements it as
+separate model classes; here it is an apply-time mode (``SpatialCtx.d2_mode``)
+of the SAME models:
+
+- :func:`accumulated_halo` computes the input-space margin
+  ``H = Σ_i p_i · Π_{j<i} s_j`` of a layer run (the receptive-field overlap of
+  the whole run).
+- :func:`run_layers_d2` exchanges that margin ONCE, then applies each layer
+  with ``SpatialCtx.halo_pre_exchanged`` set, so convs run VALID on the
+  sharded dims and consume ``p_i`` margin each; margins stay divisible by
+  construction (``m_{i+1} = (m_i - p_i)/s_i`` with H built top-down).
+
+Semantics note (same as the reference's D2): border numerics differ from the
+per-conv path — the global image is effectively zero-padded ONCE by H before
+the run, instead of re-padded at every conv; and normalisation layers inside
+a run see the not-yet-consumed margin rows.  A run whose first layers consume
+the margin before any BatchNorm (conv-first blocks) is bit-identical to D1.
+tests/test_d2.py pins both properties.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import dataclasses
+
+from mpi4dl_tpu.layer_ctx import ApplyCtx
+from mpi4dl_tpu.layers import BatchNorm, Conv2d, Identity, ReLU, Softmax
+from mpi4dl_tpu.ops.halo import HaloSpec, halo_exchange_2d
+
+
+def layer_d2_geometry(layer) -> Optional[Tuple[int, int, int, int]]:
+    """(ph, pw, sh, sw) of a layer inside a fused run, or None when the layer
+    cannot participate (pools, dense — those runs fall back to per-op D1)."""
+    if isinstance(layer, Conv2d):
+        kh, kw, sh, sw, ph, pw = layer._geometry()
+        return (ph, pw, sh, sw)
+    if isinstance(layer, (BatchNorm, ReLU, Identity, Softmax)):
+        return (0, 0, 1, 1)
+    return None
+
+
+def accumulated_halo(layers: Sequence) -> Optional[Tuple[int, int]]:
+    """Input-space halo (H_h, H_w) of a run, or None if any layer is
+    unsupported.  H = Σ p_i · (product of strides before layer i) — the
+    closed form of the reference's per-case tables
+    (resnet_spatial_d2.py:651-697)."""
+    hh = hw = 0
+    fh = fw = 1
+    for layer in layers:
+        g = layer_d2_geometry(layer)
+        if g is None:
+            return None
+        ph, pw, sh, sw = g
+        hh += ph * fh
+        hw += pw * fw
+        fh *= sh
+        fw *= sw
+    return hh, hw
+
+
+def can_fuse(layers: Sequence, sp) -> bool:
+    """A run is fusable when every layer is supported and there is a halo to
+    fuse on at least one sharded dim."""
+    acc = accumulated_halo(layers)
+    if acc is None:
+        return False
+    hh, hw = acc
+    sharded_h = bool(sp.axis_h) and sp.grid_h > 1
+    sharded_w = bool(sp.axis_w) and sp.grid_w > 1
+    return (sharded_h and hh > 0) or (sharded_w and hw > 0)
+
+
+def run_layers_d2(layers: Sequence, params_seq, x, ctx: ApplyCtx):
+    """Apply a fused run: one accumulated halo exchange, then every layer in
+    pre-exchanged (margin-consuming) mode."""
+    sp = ctx.spatial
+    assert sp is not None and sp.active
+    hh, hw = accumulated_halo(layers)
+    sharded_h = bool(sp.axis_h) and sp.grid_h > 1
+    sharded_w = bool(sp.axis_w) and sp.grid_w > 1
+    x = halo_exchange_2d(
+        x,
+        HaloSpec.symmetric(hh if sharded_h else 0),
+        HaloSpec.symmetric(hw if sharded_w else 0),
+        sp.axis_h,
+        sp.axis_w,
+        sp.grid_h,
+        sp.grid_w,
+    )
+    sub_ctx = ctx.with_spatial(dataclasses.replace(sp, halo_pre_exchanged=True))
+    for layer, p in zip(layers, params_seq):
+        x = layer.apply(p, x, sub_ctx)
+    return x
+
+
+def maybe_run_d2(layers: Sequence, params_seq, x, ctx: ApplyCtx):
+    """Fuse when D2 mode is on and the run qualifies; else return None so the
+    caller takes its normal per-layer path."""
+    sp = ctx.spatial
+    if (
+        sp is not None
+        and sp.active
+        and sp.d2_mode
+        and not sp.halo_pre_exchanged
+        and can_fuse(layers, sp)
+    ):
+        return run_layers_d2(layers, params_seq, x, ctx)
+    return None

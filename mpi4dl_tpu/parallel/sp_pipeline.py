@@ -173,7 +173,8 @@ def make_sp_pipeline_train_step(
 
     x: [B, H, W, C] global batch per data replica group; B = parts * microbatch.
     Constraints: B % S == 0 (stage blocks take equal chunks) and, for
-    junction='batch_split', microbatch % tiles == 0.
+    junction='batch_split', (B/S) % tiles == 0 (each stage chunk splits over
+    the tile grid) — both checked at trace time below.
     """
     sp = spp.sp
     part = spp.tail_part
@@ -192,7 +193,14 @@ def make_sp_pipeline_train_step(
         """Spatial region on this device's (stage-chunk, tile): returns the
         tail injection pytree [Pn, mb_tail, ...] in gathered batch order."""
         B = x_tile.shape[0]
+        assert B % S == 0, f"batch {B} must divide over {S} stage blocks"
         chunk = B // S
+        if spp.junction == "batch_split":
+            assert chunk % tiles == 0, (
+                f"stage chunk {chunk} (= batch {B} / {S} stages) must divide "
+                f"over {tiles} tiles for the batch_split junction; with parts="
+                f"{Pn} choose batch = parts * microbatch with (B/S) % tiles == 0"
+            )
         s_idx = lax.axis_index("stage")
         xs = lax.dynamic_slice_in_dim(x_tile, s_idx * chunk, chunk, axis=0)
         params_sp = spp.sp_pack.unpack(sp_flat)
