@@ -14,6 +14,7 @@ from mpi4dl_tpu.parallel.sp_pipeline import (
     SPPipeline,
     SPPipelineState,
     init_sp_pipeline_state,
+    make_sp_gems_train_step,
     make_sp_pipeline_train_step,
 )
 
@@ -30,5 +31,6 @@ __all__ = [
     "SPPipeline",
     "SPPipelineState",
     "init_sp_pipeline_state",
+    "make_sp_gems_train_step",
     "make_sp_pipeline_train_step",
 ]

@@ -38,10 +38,10 @@ from jax import lax, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from mpi4dl_tpu.layer_ctx import ApplyCtx
-from mpi4dl_tpu.parallel.partition import StagePartition, lax_slice, pad_to
+from mpi4dl_tpu.parallel.partition import StagePartition
 from mpi4dl_tpu.parallel.pipeline import PipelineState
-from mpi4dl_tpu.parallel.stage_common import make_stage_branches
-from mpi4dl_tpu.train import Optimizer, accuracy, cross_entropy
+from mpi4dl_tpu.parallel.stage_common import gems_dual_scan, make_stage_branches
+from mpi4dl_tpu.train import Optimizer
 
 
 def make_gems_train_step(
@@ -59,97 +59,28 @@ def make_gems_train_step(
     half of each pair flows forward, the second backward."""
     S = part.num_stages
     Pn = parts
-    T = Pn + S - 1
     ctx = ApplyCtx(train=True)
-    amax = part.act_max
     mirror_perm = [(i, S - 1 - i) for i in range(S)]
-    fwd_perm = [(i, i + 1) for i in range(S - 1)]
-    bwd_perm = [(i + 1, i) for i in range(S - 1)]
     grad_axes: Tuple[str, ...] = ("data",) if with_data_axis else ()
 
     branches = make_stage_branches(part, ctx, compute_dtype, remat)
 
     def sharded_step(param_row, opt_state, x, labels):
         flat_params = param_row[0]
-        d = lax.axis_index("stage")
         groups = 2 * times
         mb = x.shape[0] // (groups * Pn)
         # [times, 2, parts, mb, ...]
         xs = x.reshape(times, 2, Pn, mb, *x.shape[1:]).astype(compute_dtype)
         ys = labels.reshape(times, 2, Pn, mb)
-        in_pack0 = part.act_packs[0]
-        logits_n = part.out_pack.total
-        nclass = part.out_pack.shapes[0][-1]
-        vary = ("stage",) + grad_axes
-        v = lambda t: lax.pcast(t, vary, to="varying")
 
         def loss_and_metrics(flat_params):
             # The reverse replica's params: device d gets stage S-1-d's row.
             mirror_params = lax.ppermute(flat_params, "stage", mirror_perm)
-
-            def one_pair(carry, pair):
-                loss_in, acc_in = carry
-                xa, ya_lbl = pair[0][0], pair[1][0]
-                xb, yb_lbl = pair[0][1], pair[1][1]
-
-                def tick(c, t):
-                    bufA, bufB, l_acc, a_acc = c
-                    p_in = jnp.clip(t, 0, Pn - 1)
-                    injA = pad_to(
-                        in_pack0.pack(
-                            lax.dynamic_index_in_dim(xa, p_in, keepdims=False),
-                            compute_dtype,
-                        ),
-                        amax,
-                    )
-                    injB = pad_to(
-                        in_pack0.pack(
-                            lax.dynamic_index_in_dim(xb, p_in, keepdims=False),
-                            compute_dtype,
-                        ),
-                        amax,
-                    )
-                    bufA = jnp.where(d == 0, injA, bufA)
-                    bufB = jnp.where(d == S - 1, injB, bufB)
-                    yA = lax.switch(d, branches, flat_params, bufA)
-                    yB = lax.switch(S - 1 - d, branches, mirror_params, bufB)
-                    p_out = t - (S - 1)
-                    in_range = (p_out >= 0) & (p_out < Pn)
-                    lblA = lax.dynamic_index_in_dim(
-                        ya_lbl, jnp.clip(p_out, 0, Pn - 1), keepdims=False
-                    )
-                    lblB = lax.dynamic_index_in_dim(
-                        yb_lbl, jnp.clip(p_out, 0, Pn - 1), keepdims=False
-                    )
-                    logitsA = lax_slice(yA, 0, logits_n).reshape(mb, nclass)
-                    logitsB = lax_slice(yB, 0, logits_n).reshape(mb, nclass)
-                    validA = in_range & (d == S - 1)
-                    validB = in_range & (d == 0)
-                    l_acc = (
-                        l_acc
-                        + jnp.where(validA, cross_entropy(logitsA, lblA, from_probs), 0.0)
-                        + jnp.where(validB, cross_entropy(logitsB, lblB, from_probs), 0.0)
-                    )
-                    a_acc = (
-                        a_acc
-                        + jnp.where(validA, accuracy(logitsA, lblA), 0.0)
-                        + jnp.where(validB, accuracy(logitsB, lblB), 0.0)
-                    )
-                    bufA = lax.ppermute(yA, "stage", fwd_perm)
-                    bufB = lax.ppermute(yB, "stage", bwd_perm)
-                    return (bufA, bufB, l_acc, a_acc), None
-
-                init = (
-                    v(jnp.zeros((amax,), compute_dtype)),
-                    v(jnp.zeros((amax,), compute_dtype)),
-                    v(jnp.zeros(())),
-                    v(jnp.zeros(())),
-                )
-                (_, _, l_acc, a_acc), _ = lax.scan(tick, init, jnp.arange(T))
-                return (loss_in + l_acc, acc_in + a_acc), None
-
-            (loss_acc, acc_acc), _ = lax.scan(
-                one_pair, (v(jnp.zeros(())), v(jnp.zeros(()))), (xs, ys)
+            loss_acc, acc_acc = gems_dual_scan(
+                part, branches, flat_params, mirror_params, xs, ys,
+                vary_axes=("stage",) + grad_axes,
+                from_probs=from_probs,
+                compute_dtype=compute_dtype,
             )
             denom = 2 * times * Pn
             loss = lax.psum(loss_acc, "stage") / denom

@@ -57,7 +57,11 @@ from mpi4dl_tpu.parallel.spatial import (
     scatter_batch_over_tiles,
     tile_linear_index,
 )
-from mpi4dl_tpu.parallel.stage_common import gpipe_scan, make_stage_branches
+from mpi4dl_tpu.parallel.stage_common import (
+    gems_dual_scan,
+    gpipe_scan,
+    make_stage_branches,
+)
 from mpi4dl_tpu.train import Optimizer, spatial_partition_spec
 
 
@@ -159,29 +163,33 @@ def init_sp_pipeline_state(
     return SPPipelineState(sp_buf, tail_buf, opt_sp, opt_tail, jnp.zeros((), jnp.int32))
 
 
-def make_sp_pipeline_train_step(
+def _make_sp_step(
     spp: SPPipeline,
     optimizer: Optimizer,
     mesh: Mesh,
-    parts: int,
-    compute_dtype=jnp.float32,
-    remat: bool = True,
-    from_probs: bool = False,
-    with_data_axis: bool = False,
+    lead_shape: Tuple[int, ...],
+    scan_fn,
+    denom: int,
+    compute_dtype,
+    remat: bool,
+    with_data_axis: bool,
 ):
-    """Build `(SPPipelineState, x, labels) -> (SPPipelineState, metrics)`.
+    """Shared scaffolding of the SP(+GEMS) x PP steps: phase-1 spatial region,
+    junction, tail scan (``scan_fn``), loss reduction, grad combine, update.
 
-    x: [B, H, W, C] global batch per data replica group; B = parts * microbatch.
-    Constraints: B % S == 0 (stage blocks take equal chunks) and, for
-    junction='batch_split', (B/S) % tiles == 0 (each stage chunk splits over
-    the tile grid) — both checked at trace time below.
+    ``lead_shape`` shapes the injection pytree's leading dims —
+    ``(Pn,)`` for GPipe, ``(times, 2, Pn)`` for the GEMS dual stream.
+    ``scan_fn(branches, tail_flat, x_parts, y_parts, vary_axes)`` returns the
+    boundary-stage (loss_acc, acc_acc); ``denom`` is the drained part count.
     """
     sp = spp.sp
     part = spp.tail_part
     S = part.num_stages
-    Pn = parts
     su = spp.spatial_until
     tiles = sp.grid_h * sp.grid_w
+    groups = 1
+    for d in lead_shape:
+        groups *= d
     tile_axes = tuple(a for a in (sp.axis_h, sp.axis_w) if a)
     grad_axes: Tuple[str, ...] = ("data",) if with_data_axis else ()
     sp_ctx = ApplyCtx(train=True, spatial=sp)
@@ -191,23 +199,22 @@ def make_sp_pipeline_train_step(
 
     def phase1(sp_flat, x_tile):
         """Spatial region on this device's (stage-chunk, tile): returns the
-        tail injection pytree [Pn, mb_tail, ...] in gathered batch order."""
+        tail injection pytree [*lead_shape, mb_tail, ...] in batch order."""
         B = x_tile.shape[0]
         assert B % S == 0, f"batch {B} must divide over {S} stage blocks"
         chunk = B // S
         if spp.junction == "batch_split":
             assert chunk % tiles == 0, (
                 f"stage chunk {chunk} (= batch {B} / {S} stages) must divide "
-                f"over {tiles} tiles for the batch_split junction; with parts="
-                f"{Pn} choose batch = parts * microbatch with (B/S) % tiles == 0"
+                f"over {tiles} tiles for the batch_split junction; choose "
+                f"batch = {groups} * microbatch with (B/S) % tiles == 0"
             )
         s_idx = lax.axis_index("stage")
         xs = lax.dynamic_slice_in_dim(x_tile, s_idx * chunk, chunk, axis=0)
         params_sp = spp.sp_pack.unpack(sp_flat)
 
         def region(ps, xx):
-            act = spp.model.apply(ps, xx, sp_ctx, start=0, stop=su)
-            return act
+            return spp.model.apply(ps, xx, sp_ctx, start=0, stop=su)
 
         if remat:
             region = jax.checkpoint(region)
@@ -216,16 +223,17 @@ def make_sp_pipeline_train_step(
         act = gather_spatial(act, sp)
         if spp.junction == "batch_split":
             act = scatter_batch_over_tiles(act, sp)
+
         # Line all stage chunks up in batch order on every device.
         def g(t):
             t = lax.all_gather(t, "stage", axis=0, tiled=True)
-            return t.reshape(Pn, spp.mb_tail, *t.shape[1:])
+            return t.reshape(*lead_shape, spp.mb_tail, *t.shape[1:])
 
         return jax.tree.map(g, act)
 
     def labels_to_parts(labels):
         """The same index transform phase1 applies to images (chunk by stage
-        block, tile batch-split, gather) — applied host-side-free to labels."""
+        block, tile batch-split, gather) — applied to labels."""
         B = labels.shape[0]
         chunk = B // S
         if spp.junction == "batch_split":
@@ -235,22 +243,20 @@ def make_sp_pipeline_train_step(
             lab = lab.reshape(-1)
         else:
             lab = labels
-        return lab.reshape(Pn, spp.mb_tail)
+        return lab.reshape(*lead_shape, spp.mb_tail)
 
     def sharded_step(sp_buf, tail_row, opt_sp, opt_tail, x, labels):
         tail_flat = tail_row[0]
         y_parts = labels_to_parts(labels)
+        vary_axes = ("stage",) + tile_axes + grad_axes
 
         def loss_and_metrics(sp_flat, tail_flat):
             x_parts = phase1(sp_flat, x)
-            loss_acc, acc_acc = gpipe_scan(
-                part, branches, tail_flat, x_parts, y_parts,
-                vary_axes=("stage",) + tile_axes + grad_axes,
-                from_probs=from_probs,
-                compute_dtype=compute_dtype,
+            loss_acc, acc_acc = scan_fn(
+                branches, tail_flat, x_parts, y_parts, vary_axes
             )
-            loss = lax.psum(loss_acc, "stage") / Pn
-            acc = lax.psum(acc_acc, "stage") / Pn
+            loss = lax.psum(loss_acc, "stage") / denom
+            acc = lax.psum(acc_acc, "stage") / denom
             if tile_axes:
                 loss = lax.pmean(loss, tile_axes)
                 acc = lax.pmean(acc, tile_axes)
@@ -303,3 +309,74 @@ def make_sp_pipeline_train_step(
         )
 
     return step
+
+
+def make_sp_pipeline_train_step(
+    spp: SPPipeline,
+    optimizer: Optimizer,
+    mesh: Mesh,
+    parts: int,
+    compute_dtype=jnp.float32,
+    remat: bool = True,
+    from_probs: bool = False,
+    with_data_axis: bool = False,
+):
+    """Build `(SPPipelineState, x, labels) -> (SPPipelineState, metrics)`.
+
+    x: [B, H, W, C] global batch per data replica group; B = parts * microbatch.
+    Constraints: B % S == 0 (stage blocks take equal chunks) and, for
+    junction='batch_split', (B/S) % tiles == 0 (each stage chunk splits over
+    the tile grid) — both checked at trace time.
+    """
+    part = spp.tail_part
+
+    def scan_fn(branches, tail_flat, x_parts, y_parts, vary_axes):
+        return gpipe_scan(
+            part, branches, tail_flat, x_parts, y_parts,
+            vary_axes=vary_axes,
+            from_probs=from_probs,
+            compute_dtype=compute_dtype,
+        )
+
+    return _make_sp_step(
+        spp, optimizer, mesh, (parts,), scan_fn, parts,
+        compute_dtype, remat, with_data_axis,
+    )
+
+
+def make_sp_gems_train_step(
+    spp: SPPipeline,
+    optimizer: Optimizer,
+    mesh: Mesh,
+    parts: int,
+    times: int = 1,
+    compute_dtype=jnp.float32,
+    remat: bool = True,
+    from_probs: bool = False,
+    with_data_axis: bool = False,
+):
+    """SP x GEMS x PP — the reference's flagship 5D composition
+    (``train_spatial_master.py``: two spatial models over mirrored rank sets
+    with flat param/grad exchange; here ONE weight set, the reverse stream
+    reading mirror-ppermuted stage rows, see parallel/gems.py).
+
+    x: [B, H, W, C] with B = 2 * times * parts * microbatch per data replica;
+    pairs alternate direction through the tail stage chain.
+    """
+    part = spp.tail_part
+    S = part.num_stages
+    mirror_perm = [(i, S - 1 - i) for i in range(S)]
+
+    def scan_fn(branches, tail_flat, x_parts, y_parts, vary_axes):
+        mirror_params = lax.ppermute(tail_flat, "stage", mirror_perm)
+        return gems_dual_scan(
+            part, branches, tail_flat, mirror_params, x_parts, y_parts,
+            vary_axes=vary_axes,
+            from_probs=from_probs,
+            compute_dtype=compute_dtype,
+        )
+
+    return _make_sp_step(
+        spp, optimizer, mesh, (times, 2, parts), scan_fn, 2 * times * parts,
+        compute_dtype, remat, with_data_axis,
+    )

@@ -115,3 +115,99 @@ def gpipe_scan(
         tick, (buf0, v(jnp.zeros(())), v(jnp.zeros(()))), jnp.arange(T)
     )
     return loss_acc, acc_acc
+
+
+def gems_dual_scan(
+    part: StagePartition,
+    branches: List[Callable],
+    flat_params: jax.Array,
+    mirror_params: jax.Array,
+    x_groups,
+    y_groups: jax.Array,
+    *,
+    vary_axes: Tuple[str, ...],
+    from_probs: bool,
+    compute_dtype,
+):
+    """The GEMS bidirectional tick loop (reference gems_master.py:72-103).
+
+    x_groups: pytree with leaves [times, 2, Pn, mb, ...]; y_groups
+    [times, 2, Pn, mb].  Stream A of each pair flows stage 0→S-1 with the true
+    params; stream B flows S-1→0 against ``mirror_params`` (device d holding
+    stage S-1-d's row via the mirror ppermute) — the two switch branches per
+    tick are what XLA interleaves into bidirectional bubble-filling.  Returns
+    (loss_acc, acc_acc) accumulated on the boundary stages over all
+    2·times·Pn drained parts; callers psum over 'stage' and normalise.
+    """
+    S = part.num_stages
+    lead = jax.tree.leaves(x_groups)[0]
+    times, Pn, mb = lead.shape[0], lead.shape[2], lead.shape[3]
+    T = Pn + S - 1
+    d = lax.axis_index("stage")
+    in_pack0 = part.act_packs[0]
+    logits_n = part.out_pack.total
+    nclass = part.out_pack.shapes[0][-1]
+    amax = part.act_max
+    fwd_perm = [(i, i + 1) for i in range(S - 1)]
+    bwd_perm = [(i + 1, i) for i in range(S - 1)]
+
+    def v(t):
+        return lax.pcast(t, vary_axes, to="varying")
+
+    def one_pair(carry, pair):
+        loss_in, acc_in = carry
+        xp, yp = pair  # leaves [2, Pn, mb, ...], [2, Pn, mb]
+
+        def sel(tree, j, p):
+            return jax.tree.map(
+                lambda a: lax.dynamic_index_in_dim(
+                    a[j], p, keepdims=False
+                ),
+                tree,
+            )
+
+        def tick(c, t):
+            bufA, bufB, l_acc, a_acc = c
+            p_in = jnp.clip(t, 0, Pn - 1)
+            injA = pad_to(in_pack0.pack(sel(xp, 0, p_in), compute_dtype), amax)
+            injB = pad_to(in_pack0.pack(sel(xp, 1, p_in), compute_dtype), amax)
+            bufA = jnp.where(d == 0, injA, bufA)
+            bufB = jnp.where(d == S - 1, injB, bufB)
+            yA = lax.switch(d, branches, flat_params, bufA)
+            yB = lax.switch(S - 1 - d, branches, mirror_params, bufB)
+            p_out = t - (S - 1)
+            in_range = (p_out >= 0) & (p_out < Pn)
+            p_sel = jnp.clip(p_out, 0, Pn - 1)
+            lblA = lax.dynamic_index_in_dim(yp[0], p_sel, keepdims=False)
+            lblB = lax.dynamic_index_in_dim(yp[1], p_sel, keepdims=False)
+            logitsA = lax_slice(yA, 0, logits_n).reshape(mb, nclass)
+            logitsB = lax_slice(yB, 0, logits_n).reshape(mb, nclass)
+            validA = in_range & (d == S - 1)
+            validB = in_range & (d == 0)
+            l_acc = (
+                l_acc
+                + jnp.where(validA, cross_entropy(logitsA, lblA, from_probs), 0.0)
+                + jnp.where(validB, cross_entropy(logitsB, lblB, from_probs), 0.0)
+            )
+            a_acc = (
+                a_acc
+                + jnp.where(validA, accuracy(logitsA, lblA), 0.0)
+                + jnp.where(validB, accuracy(logitsB, lblB), 0.0)
+            )
+            bufA = lax.ppermute(yA, "stage", fwd_perm)
+            bufB = lax.ppermute(yB, "stage", bwd_perm)
+            return (bufA, bufB, l_acc, a_acc), None
+
+        init = (
+            v(jnp.zeros((amax,), compute_dtype)),
+            v(jnp.zeros((amax,), compute_dtype)),
+            v(jnp.zeros(())),
+            v(jnp.zeros(())),
+        )
+        (_, _, l_acc, a_acc), _ = lax.scan(tick, init, jnp.arange(T))
+        return (loss_in + l_acc, acc_in + a_acc), None
+
+    (loss_acc, acc_acc), _ = lax.scan(
+        one_pair, (v(jnp.zeros(())), v(jnp.zeros(()))), (x_groups, y_groups)
+    )
+    return loss_acc, acc_acc
