@@ -1,40 +1,60 @@
-"""Headline benchmark — prints ONE JSON line.
+"""Headline benchmark — prints ONE JSON line, always.
 
 Metric: AmoebaNet-D training throughput (images/sec) on one chip at the
-reference's flagship 1024x1024 resolution, batch size 1 (the configuration of
-the reference's published charts, BASELINE.md: best bs1 result at 1024^2 is
-~2.1 img/s for SP square + halo-D2 across 5 GPUs).  ``vs_baseline`` is
-images/sec divided by that 2.1 img/s reference number.
+reference's flagship 1024x1024 resolution, batch size 1 — the configuration of
+the reference's published charts (BASELINE.md: best bs1 result at 1024² is
+≈2.1 img/s for SP square + halo-D2 across FIVE GPUs, i.e. ≈0.42 img/s/GPU).
 
-On a CPU host (no TPU attached) the benchmark downsizes so it still completes;
-the driver runs it on real TPU hardware.
+``vs_baseline`` is our single-chip img/s divided by the 2.1 img/s cluster bar
+(the headline comparison, chip-count mismatch stated in the metric name);
+``vs_baseline_per_device`` divides by 2.1/5.  Both are null when the run had
+to fall back to an incomparable configuration (CPU smoke / reduced size).
+
+Robustness: the measurement runs in a SUBPROCESS so a broken TPU plugin (the
+round-1 failure: axon init raised at jax.devices()) cannot kill the benchmark
+before it prints.  Ladder: TPU@1024² → TPU@512² → CPU smoke.  The outer
+process re-prints the first inner JSON line that parses; if every rung fails
+it still prints a JSON line with value 0 and the failure tail.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
+BASELINE_CLUSTER = 2.1   # reference: AmoebaNet-D 1024² bs1, SP square + D2, 5 GPUs
+BASELINE_DEVICES = 5
 
-from mpi4dl_tpu.models.amoebanet import amoebanetd
-from mpi4dl_tpu.train import Optimizer, TrainState, make_train_step
+# (name, platform, image_size, num_layers, num_filters, warmup, iters, timeout_s, comparable)
+LADDER = [
+    ("tpu_1024", "tpu", 1024, 18, 416, 2, 8, 1500, True),
+    ("tpu_512", "tpu", 512, 18, 416, 2, 8, 900, False),
+    ("cpu_smoke", "cpu", 128, 3, 64, 1, 3, 600, False),
+]
 
-BASELINE_IMG_PER_SEC = 2.1  # reference: AmoebaNet-D 1024^2 bs1, SP square + D2, 5 GPUs
 
+def _inner(platform: str, image_size: int, num_layers: int, num_filters: int,
+           warmup: int, iters: int, comparable: bool) -> None:
+    import jax
+    import jax.numpy as jnp
 
-def main() -> None:
-    platform = jax.devices()[0].platform
-    on_tpu = platform == "tpu"
+    from mpi4dl_tpu.models.amoebanet import amoebanetd
+    from mpi4dl_tpu.train import Optimizer, TrainState, make_train_step
 
-    if on_tpu:
-        image_size, num_layers, num_filters, batch = 1024, 18, 416, 1
-        warmup, iters = 2, 8
-    else:  # smoke mode for CPU-only environments
-        image_size, num_layers, num_filters, batch = 128, 3, 64, 1
-        warmup, iters = 1, 3
+    dev = jax.devices()[0]
+    print(f"[bench] platform={dev.platform} device={dev}", file=sys.stderr)
+    # The axon TPU plugin may report its platform as 'tpu' or 'axon'; the only
+    # disqualifying case is a TPU rung landing on the CPU fallback (it would
+    # grind the huge config on the host) and vice versa.
+    is_cpu = dev.platform == "cpu"
+    if (platform == "tpu") == is_cpu:
+        print(f"[bench] wanted {platform!r}, got {dev.platform!r} — bail",
+              file=sys.stderr)
+        sys.exit(3)
+    batch = 1
 
     model = amoebanetd(
         (batch, image_size, image_size, 3),
@@ -44,15 +64,19 @@ def main() -> None:
     )
     params, _ = model.init(jax.random.key(0))
     opt = Optimizer("sgd", lr=0.001)
-    step = make_train_step(model, opt, compute_dtype=jnp.bfloat16)
+    # bf16 compute + per-cell remat: the memory configuration that fits
+    # 1024² bs1 on one chip (the reference needs 5 GPUs for this workload).
+    step = make_train_step(model, opt, compute_dtype=jnp.bfloat16, remat=True)
     state = TrainState.create(params, opt)
 
     x = jax.random.normal(jax.random.key(1), (batch, image_size, image_size, 3))
     y = jnp.zeros((batch,), jnp.int32)
 
+    t_c = time.perf_counter()
     for _ in range(warmup):
         state, metrics = step(state, x, y)
     jax.block_until_ready(metrics["loss"])
+    print(f"[bench] compile+warmup {time.perf_counter() - t_c:.1f}s", file=sys.stderr)
 
     t0 = time.perf_counter()
     for _ in range(iters):
@@ -62,14 +86,75 @@ def main() -> None:
 
     img_per_sec = batch * iters / dt
     out = {
-        "metric": f"amoebanetd_{image_size}px_bs{batch}_train_img_per_sec_per_chip",
+        "metric": f"amoebanetd_{image_size}px_bs{batch}_train_img_per_sec"
+                  "_single_chip_vs_5gpu_cluster_baseline",
         "value": round(img_per_sec, 4),
         "unit": "images/sec",
-        # Only the TPU run at the reference resolution is comparable to the
-        # reference's 2.1 img/s; the CPU smoke config reports 0.
-        "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 4) if on_tpu else 0.0,
+        "vs_baseline": round(img_per_sec / BASELINE_CLUSTER, 4) if comparable else None,
+        "vs_baseline_per_device": (
+            round(img_per_sec / (BASELINE_CLUSTER / BASELINE_DEVICES), 4)
+            if comparable else None
+        ),
+        "baseline_img_per_sec_cluster": BASELINE_CLUSTER,
+        "baseline_devices": BASELINE_DEVICES,
+        "platform": jax.devices()[0].platform,
     }
     print(json.dumps(out))
+
+
+def _try_rung(name, platform, image_size, num_layers, num_filters,
+              warmup, iters, timeout_s, comparable):
+    env = dict(os.environ)
+    if platform == "cpu":
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+    argv = [sys.executable, os.path.abspath(__file__), "--inner",
+            platform, str(image_size), str(num_layers), str(num_filters),
+            str(warmup), str(iters), "1" if comparable else "0"]
+    try:
+        proc = subprocess.run(
+            argv, env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired as e:
+        return None, f"{name}: timeout after {timeout_s}s; stderr tail: " \
+                     f"{(e.stderr or '')[-300:] if isinstance(e.stderr, str) else ''}"
+    sys.stderr.write(proc.stderr[-2000:] if proc.stderr else "")
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), None
+            except json.JSONDecodeError:
+                continue
+    return None, f"{name}: rc={proc.returncode}; stderr tail: {(proc.stderr or '')[-300:]}"
+
+
+def main() -> int:
+    if len(sys.argv) > 1 and sys.argv[1] == "--inner":
+        platform, image_size, num_layers, num_filters, warmup, iters, comp = sys.argv[2:9]
+        _inner(platform, int(image_size), int(num_layers), int(num_filters),
+               int(warmup), int(iters), comp == "1")
+        return 0
+
+    failures = []
+    for rung in LADDER:
+        print(f"[bench] trying rung {rung[0]}", file=sys.stderr)
+        result, err = _try_rung(*rung)
+        if result is not None:
+            print(json.dumps(result))
+            return 0
+        failures.append(err)
+        print(f"[bench] rung failed: {err}", file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "amoebanetd_train_img_per_sec_single_chip",
+        "value": 0,
+        "unit": "images/sec",
+        "vs_baseline": None,
+        "error": "; ".join(f for f in failures if f)[-500:],
+    }))
+    return 0
 
 
 if __name__ == "__main__":

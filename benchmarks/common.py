@@ -1,0 +1,284 @@
+"""Shared benchmark runner — the L6 entry-point layer.
+
+The reference implements twelve near-identical script bodies (parse → MPIComm
+→ shape probe → model → runtime → dataset → epoch loop with CUDA-event img/s
+timing; flagship flow `benchmark_amoebanet_sp.py:116-371`).  Here the flow is
+one function parameterized by (family, model):
+
+    parse flags (config.get_parser, reference parser.py vocabulary)
+    → MeshSpec.from_config / build_mesh     (replaces MPIComm rank math)
+    → build_model + spatial_until placement (replaces the two-phase shape
+      probe: shapes come from jax.eval_shape inside the builders)
+    → the family's train-step builder       (replaces train_model* runtimes)
+    → make_dataset APP dispatch             (reference APP 1/2/3)
+    → epoch loop printing per-step images/sec + mean/median via StepMeter
+      (reference output format, benchmark_amoebanet_sp.py:322-367)
+
+Families:
+  lp       — LP/PP pipeline (reference benchmarks/layer_parallelism)
+  sp       — spatial(+pipeline tail) (reference benchmarks/spatial_parallelism)
+  gems     — GEMS bidirectional (reference benchmarks/gems_master_model)
+  gems_sp  — GEMS x SP x PP (reference gems_master_with_spatial_parallelism)
+
+Every script runs on any JAX platform; on a CPU host pass small flags, e.g.
+  python benchmark_resnet_sp.py --image-size 32 --num-layers 1 --batch-size 4
+with XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import sys
+import threading
+
+# Make `mpi4dl_tpu` importable when a benchmark script is run by path.
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from mpi4dl_tpu.config import ParallelConfig, config_from_args, get_parser
+from mpi4dl_tpu.utils import StepMeter, Timer
+
+
+def _spatial_ctx(cfg: ParallelConfig):
+    from mpi4dl_tpu.layer_ctx import spatial_ctx_for
+
+    return spatial_ctx_for(
+        cfg.slice_method,
+        cfg.spatial_part_size,
+        bn_cross_tile=cfg.bn_cross_tile,
+        d2_mode=cfg.halo_d2,
+        # --fused-layers caps margin-consuming layers per fused exchange
+        # (reference resnet_spatial_d2.py get_balance); <=0 → maximal fusion.
+        d2_max_fused=cfg.fused_layers if cfg.fused_layers > 0 else None,
+    )
+
+
+def _spatial_until(cfg: ParallelConfig, n_cells: int) -> int:
+    """Number of leading cells in the spatial region: the cells of the first
+    `spatial_size` pipeline splits (reference: the first spatial_size splits
+    run conv_spatial, resnet_spatial.py:272-296)."""
+    from mpi4dl_tpu.cells import split_even
+
+    ranges = split_even(n_cells, max(cfg.split_size, 1), cfg.balance)
+    take = min(max(cfg.spatial_size, 1), len(ranges))
+    return ranges[take - 1][1]
+
+
+def build_train(cfg: ParallelConfig, family: str, mesh):
+    """Return (step, state, eval_params_fn, global_batch).
+
+    ``eval_params_fn(state) -> params_list`` reassembles full parameters for
+    the eval step / checkpointing regardless of the family's state layout.
+    """
+    import jax
+
+    from mpi4dl_tpu.models import build_model
+    from mpi4dl_tpu.train import Optimizer, TrainState
+
+    model = build_model(cfg)
+    params, _ = model.init(jax.random.key(cfg.seed))
+    opt = Optimizer(cfg.optimizer, lr=cfg.lr, momentum=cfg.momentum)
+    dp = cfg.data_parallel
+    dtype = cfg.compute_dtype
+    from_probs = cfg.softmax_in_model
+
+    if family == "lp":
+        if cfg.split_size <= 1:
+            from mpi4dl_tpu.train import make_train_step
+
+            step = make_train_step(
+                model, opt, mesh if dp > 1 else None, parts=cfg.parts,
+                compute_dtype=dtype, from_probs=from_probs, remat=cfg.remat,
+            )
+            state = TrainState.create(params, opt)
+            return step, state, (lambda s: s.params), cfg.batch_size * dp
+        from mpi4dl_tpu.parallel.partition import StagePartition
+        from mpi4dl_tpu.parallel.pipeline import (
+            init_pipeline_state,
+            make_pipeline_train_step,
+        )
+
+        mb = cfg.batch_size // cfg.parts
+        part = StagePartition.build(
+            model, params, cfg.split_size,
+            (mb, cfg.image_size, cfg.image_size, 3),
+            balance=cfg.balance, compute_dtype=dtype,
+        )
+        step = make_pipeline_train_step(
+            part, opt, mesh, cfg.parts, compute_dtype=dtype, remat=cfg.remat,
+            from_probs=from_probs, with_data_axis=dp > 1,
+        )
+        state = init_pipeline_state(part, params, opt, mesh)
+        return (
+            step, state,
+            (lambda s: part.unpack_params(jax.device_get(s.param_buf))),
+            cfg.batch_size * dp,
+        )
+
+    if family == "gems":
+        from mpi4dl_tpu.parallel.gems import make_gems_train_step
+        from mpi4dl_tpu.parallel.partition import StagePartition
+        from mpi4dl_tpu.parallel.pipeline import init_pipeline_state
+
+        groups = 2 * cfg.times * cfg.parts
+        assert cfg.batch_size % groups == 0, (
+            f"GEMS needs batch_size divisible by 2*times*parts={groups}"
+        )
+        mb = cfg.batch_size // groups
+        part = StagePartition.build(
+            model, params, cfg.split_size,
+            (mb, cfg.image_size, cfg.image_size, 3),
+            balance=cfg.balance, compute_dtype=dtype,
+        )
+        step = make_gems_train_step(
+            part, opt, mesh, cfg.parts, times=cfg.times, compute_dtype=dtype,
+            remat=cfg.remat, from_probs=from_probs, with_data_axis=dp > 1,
+        )
+        state = init_pipeline_state(part, params, opt, mesh)
+        return (
+            step, state,
+            (lambda s: part.unpack_params(jax.device_get(s.param_buf))),
+            cfg.batch_size * dp,
+        )
+
+    # Spatial families
+    sp = _spatial_ctx(cfg)
+    model.spatial_until = _spatial_until(cfg, len(model.cells))
+    junction = "batch_split" if cfg.local_dp_lp > 1 else "gather"
+
+    if family == "sp" and cfg.split_size <= 1:
+        from mpi4dl_tpu.train import make_spatial_train_step
+
+        step = make_spatial_train_step(
+            model, opt, mesh, sp, parts=cfg.parts, with_data_axis=dp > 1,
+            compute_dtype=dtype, from_probs=from_probs,
+            spatial_until=model.spatial_until, junction=junction,
+        )
+        state = TrainState.create(params, opt)
+        return step, state, (lambda s: s.params), cfg.batch_size * dp
+
+    from mpi4dl_tpu.parallel.sp_pipeline import (
+        SPPipeline,
+        init_sp_pipeline_state,
+        make_sp_gems_train_step,
+        make_sp_pipeline_train_step,
+    )
+
+    groups = (2 * cfg.times * cfg.parts) if family == "gems_sp" else cfg.parts
+    assert cfg.batch_size % groups == 0, (cfg.batch_size, groups)
+    micro = cfg.batch_size // groups
+    spp = SPPipeline.build(
+        model, params, max(cfg.split_size, 2), sp, microbatch=micro,
+        junction=junction, balance=cfg.balance, compute_dtype=dtype,
+    )
+    if family == "gems_sp":
+        step = make_sp_gems_train_step(
+            spp, opt, mesh, cfg.parts, times=cfg.times, compute_dtype=dtype,
+            remat=cfg.remat, from_probs=from_probs, with_data_axis=dp > 1,
+        )
+    else:
+        step = make_sp_pipeline_train_step(
+            spp, opt, mesh, cfg.parts, compute_dtype=dtype, remat=cfg.remat,
+            from_probs=from_probs, with_data_axis=dp > 1,
+        )
+    state = init_sp_pipeline_state(spp, params, opt, mesh)
+    return (
+        step, state,
+        (lambda s: spp.unpack_all(
+            jax.device_get(s.sp_buf), jax.device_get(s.tail_buf))),
+        cfg.batch_size * dp,
+    )
+
+
+def _batches(dataset, batch_size: int, steps: int, num_workers: int):
+    """Host batch iterator; num_workers>0 prefetches on a background thread
+    (the reference's DataLoader num_workers analog)."""
+    if num_workers <= 0:
+        for i in range(steps):
+            yield dataset.batch(i, batch_size)
+        return
+    q: queue.Queue = queue.Queue(maxsize=max(2, num_workers))
+
+    def producer():
+        for i in range(steps):
+            q.put(dataset.batch(i, batch_size))
+        q.put(None)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is None:
+            return
+        yield item
+
+
+def run(family: str, model: str, argv=None) -> dict:
+    """Parse flags and run the benchmark; returns the final summary dict."""
+    import jax
+    import numpy as np
+
+    parser = get_parser()
+    parser.set_defaults(model=model)
+    parser.add_argument("--steps-per-epoch", type=int, default=10)
+    args = parser.parse_args(argv)
+    cfg = config_from_args(args)
+    if cfg.enable_master_comm_opt:
+        print(
+            "note: --enable-master-comm-opt is a no-op here — the one-weight-"
+            "set GEMS redesign cannot diverge, so the reference's MASTER-OPT "
+            "param/grad exchange (train_spatial_master.py:229-455) has "
+            "nothing to synchronize."
+        )
+
+    from mpi4dl_tpu.data import make_dataset
+    from mpi4dl_tpu.mesh import MeshSpec, build_mesh
+
+    spec = MeshSpec.from_config(cfg) if family != "lp" and family != "gems" else (
+        MeshSpec(data=cfg.data_parallel, stage=max(cfg.split_size, 1))
+    )
+    devices = jax.devices()
+    print(f"devices: {len(devices)} x {devices[0].platform}; mesh {spec}")
+    mesh = build_mesh(spec, devices)
+
+    step, state, eval_params_fn, global_batch = build_train(cfg, family, mesh)
+
+    # Optional checkpoint resume (reference has no checkpointing; SURVEY §5
+    # plans it as a new capability).
+    ckpt_mgr = None
+    if cfg.checkpoint_dir:
+        from mpi4dl_tpu.checkpoint import CheckpointManager
+
+        ckpt_mgr = CheckpointManager(cfg.checkpoint_dir)
+        state = ckpt_mgr.restore_latest(state)
+
+    dataset = make_dataset(cfg)
+    steps = args.steps_per_epoch
+    meter = StepMeter(global_batch)
+    timer = Timer()
+    metrics = {}
+    for epoch in range(cfg.num_epochs):
+        for i, (x, y) in enumerate(
+            _batches(dataset, global_batch, steps, cfg.num_workers)
+        ):
+            timer.start()
+            state, metrics = step(state, x, y)
+            loss = float(metrics["loss"])  # blocks until the step finishes
+            ms = timer.stop()
+            if epoch > 0 or i > 0:  # skip compile step in the meter
+                meter.add(ms)
+            print(
+                f"epoch {epoch} step {i} time_ms {ms:.1f} "
+                f"images_per_sec {global_batch / (ms / 1e3):.3f} "
+                f"loss {loss:.4f} acc {float(metrics['accuracy']):.4f}"
+            )
+        if ckpt_mgr is not None:
+            ckpt_mgr.save(state, step_id=(epoch + 1) * steps)
+    print(meter.summary())
+    return {
+        "images_per_sec": meter.images_per_sec(),
+        "loss": float(metrics["loss"]) if metrics else float("nan"),
+        "steps": len(meter.times_ms),
+    }

@@ -1,0 +1,121 @@
+"""Halo-exchange latency microbenchmark + validation.
+
+Reference: benchmarks/communication/halo/benchmark_sp_halo_exchange.py —
+arange-image construction (:417-557), exact compare vs the globally
+zero-padded image (:568-578), warmup + CUDA-event timed loop (:581-615).
+Its published sample: ≈0.334 ms/iter at 1024², 4-way vertical, halo 3,
+batch 1 on 4 GPUs (halo README:29-43).
+
+This version runs the same experiment as ONE jitted shard_map program whose
+only body is the halo exchange (4 ppermutes max), on whatever platform JAX
+offers: a TPU mesh when multiple chips are attached, else the forced-host
+8-device CPU mesh (functional validation; CPU timing is not comparable).
+
+Example:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \\
+  python benchmark_sp_halo_exchange.py --image-size 256 --halo-len 3 \\
+      --num-spatial-parts 4 --slice-method vertical
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0,
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))),
+)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--image-size", type=int, default=256)
+    p.add_argument("--batch-size", type=int, default=1)
+    p.add_argument("--channels", type=int, default=3)
+    p.add_argument("--halo-len", type=int, default=3)
+    p.add_argument("--num-spatial-parts", type=int, default=4)
+    p.add_argument("--slice-method", default="vertical",
+                   help="square | vertical | horizontal")
+    p.add_argument("--warmup", type=int, default=10)
+    p.add_argument("--iterations", type=int, default=100)
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from mpi4dl_tpu.layer_ctx import spatial_ctx_for
+    from mpi4dl_tpu.mesh import MeshSpec, build_mesh
+    from mpi4dl_tpu.ops.halo import HaloSpec, halo_exchange_2d
+
+    sp = spatial_ctx_for(args.slice_method, args.num_spatial_parts)
+    mesh = build_mesh(MeshSpec(sph=sp.grid_h, spw=sp.grid_w), jax.devices())
+    h = args.halo_len
+    size, b, c = args.image_size, args.batch_size, args.channels
+    spec = P(None, sp.axis_h, sp.axis_w, None)
+    halo_h = HaloSpec.symmetric(h if sp.grid_h > 1 else 0)
+    halo_w = HaloSpec.symmetric(h if sp.grid_w > 1 else 0)
+
+    fn = jax.jit(
+        shard_map(
+            lambda t: halo_exchange_2d(
+                t, halo_h, halo_w, sp.axis_h, sp.axis_w, sp.grid_h, sp.grid_w
+            ),
+            mesh=mesh, in_specs=spec, out_specs=spec,
+        )
+    )
+
+    # --- validation: arange image, exact compare against the matching window
+    # of the globally zero-padded image (reference :417-461 per slice method).
+    x = jnp.arange(b * size * size * c, dtype=jnp.float32).reshape(b, size, size, c)
+    out = np.asarray(jax.block_until_ready(fn(x)))
+    padded = np.pad(
+        np.asarray(x), ((0, 0), (halo_h.lo, halo_h.hi), (halo_w.lo, halo_w.hi), (0, 0))
+    )
+    th, tw = size // sp.grid_h, size // sp.grid_w
+    eth, etw = th + 2 * halo_h.lo, tw + 2 * halo_w.lo
+    ok = True
+    # shard_map concatenates per-tile outputs along the sharded dims.
+    for r in range(sp.grid_h):
+        for cc in range(sp.grid_w):
+            got = out[:, r * eth : (r + 1) * eth, cc * etw : (cc + 1) * etw]
+            want = padded[:, r * th : r * th + eth, cc * tw : cc * tw + etw]
+            if not np.array_equal(got, want):
+                ok = False
+    print(f"validation: {'PASSED' if ok else 'FAILED'}")
+
+    # --- timed loop (reference :598-613: warmup then per-iter timing) ---
+    for _ in range(args.warmup):
+        out_d = fn(x)
+    jax.block_until_ready(out_d)
+    times = []
+    for _ in range(args.iterations):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(x))
+        times.append((time.perf_counter() - t0) * 1e3)
+    times_np = np.asarray(times)
+    result = {
+        "metric": "halo_exchange_ms_per_iter",
+        "value": round(float(np.mean(times_np)), 4),
+        "median_ms": round(float(np.median(times_np)), 4),
+        "min_ms": round(float(np.min(times_np)), 4),
+        "platform": jax.devices()[0].platform,
+        "config": {
+            "image_size": size, "batch": b, "channels": c, "halo_len": h,
+            "parts": args.num_spatial_parts, "slice_method": args.slice_method,
+        },
+        "validation": "pass" if ok else "FAIL",
+        "reference_ms": 0.334,  # 4xGPU MVAPICH2-GDR sample, halo README:29-43
+    }
+    print(json.dumps(result))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

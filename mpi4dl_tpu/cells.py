@@ -113,11 +113,21 @@ class CellModel:
         return params_list, shapes
 
     def apply(self, params_list, x: Act, ctx: ApplyCtx, *,
-              start: int = 0, stop: Optional[int] = None) -> Act:
-        """Run cells [start, stop) — the per-stage sub-model."""
+              start: int = 0, stop: Optional[int] = None,
+              remat: bool = False) -> Act:
+        """Run cells [start, stop) — the per-stage sub-model.
+
+        ``remat=True`` wraps each cell in :func:`jax.checkpoint` so backward
+        recomputes activations per cell instead of storing them — the memory
+        lever that lets high-resolution configs (the reference's 1024²-2048²
+        charts, BASELINE.md) fit on a single chip.
+        """
         stop = len(self.cells) if stop is None else stop
         for i in range(start, stop):
-            x = self.cells[i].apply(params_list[i], x, ctx)
+            if remat:
+                x = _apply_cell_remat(self.cells[i], params_list[i], x, ctx)
+            else:
+                x = self.cells[i].apply(params_list[i], x, ctx)
         return x
 
     def out_shapes(self, params_list) -> List[ShapeLike]:
@@ -130,6 +140,33 @@ class CellModel:
                 tuple(t.shape for t in x) if isinstance(x, tuple) else x.shape
             )
         return shapes
+
+
+def _apply_cell_remat(cell: Cell, params, x: Act, ctx: ApplyCtx) -> Act:
+    """Apply one cell under jax.checkpoint.
+
+    When a BN stats sink is active it must cross the checkpoint boundary
+    explicitly: the sink captures tracers of the INNER (rematerialized) trace,
+    which would escape if consumed outside.  The checkpointed fn therefore
+    returns the cell's stat updates aligned to the cell's flattened param
+    leaves, and they are re-deposited into the outer sink under the OUTER
+    leaves' ids."""
+    import dataclasses as _dc
+
+    if ctx.bn_sink is None:
+        return jax.checkpoint(lambda p, x: cell.apply(p, x, ctx))(params, x)
+
+    def fn(p, x):
+        inner: dict = {}
+        y = cell.apply(p, x, _dc.replace(ctx, bn_sink=inner))
+        stats = [inner.get(id(leaf)) for leaf in jax.tree.leaves(p)]
+        return y, stats
+
+    y, stats = jax.checkpoint(fn)(params, x)
+    for leaf, s in zip(jax.tree.leaves(params), stats):
+        if s is not None:
+            ctx.bn_sink[id(leaf)] = s
+    return y
 
 
 def split_even(n_cells: int, split_size: int, balance: Optional[Sequence[int]] = None

@@ -1,0 +1,113 @@
+"""Checkpoint / resume.
+
+The reference has NO checkpointing anywhere (no torch.save/load in the repo —
+SURVEY §5 plans this as a new capability, not parity).  Design: any training
+state — TrainState, PipelineState, SPPipelineState, all registered dataclass
+pytrees — is flattened to leaves and written as one .npz; restore maps leaves
+back into a TEMPLATE state of the same structure (the state freshly built by
+the step builders), so no pytree schema needs serializing.  Sharded arrays
+round-trip through jax.device_get / device_put with the template's sharding,
+which makes resume bit-identical including flat stage buffers and optimizer
+state.
+
+Writes are atomic (tmp file + rename) so a killed run never leaves a torn
+checkpoint behind.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import tempfile
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+_CKPT_RE = re.compile(r"^ckpt_(\d+)\.npz$")
+
+
+def save_state(path: str, state: Any, step_id: int) -> None:
+    """Write `state` (any pytree of arrays) to `path` atomically."""
+    leaves = jax.tree.leaves(state)
+    arrays = {f"leaf_{i}": np.asarray(jax.device_get(l)) for i, l in enumerate(leaves)}
+    arrays["__step_id__"] = np.asarray(step_id, np.int64)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def restore_state(path: str, template: Any) -> Any:
+    """Load leaves from `path` into the structure (and shardings) of
+    `template`.  Shapes/dtypes are checked leaf-by-leaf."""
+    leaves, treedef = jax.tree.flatten(template)
+    with np.load(path) as z:
+        n = sum(1 for k in z.files if k.startswith("leaf_"))
+        if n != len(leaves):
+            raise ValueError(
+                f"checkpoint {path} has {n} leaves, state needs {len(leaves)}"
+            )
+        new_leaves = []
+        for i, tmpl in enumerate(leaves):
+            arr = z[f"leaf_{i}"]
+            tshape = tuple(getattr(tmpl, "shape", np.shape(tmpl)))
+            if tuple(arr.shape) != tshape:
+                raise ValueError(
+                    f"leaf {i}: checkpoint shape {arr.shape} != state {tshape}"
+                )
+            if isinstance(tmpl, jax.Array):
+                arr = arr.astype(tmpl.dtype)
+                # Re-apply mesh shardings (flat stage buffers etc.); leave
+                # single-device leaves UNCOMMITTED (jnp.asarray) — committing
+                # them to a fixed device would conflict with mesh-sharded
+                # siblings inside one jitted step.
+                if len(tmpl.sharding.device_set) > 1:
+                    new_leaves.append(jax.device_put(arr, tmpl.sharding))
+                else:
+                    new_leaves.append(jax.numpy.asarray(arr))
+            else:
+                new_leaves.append(np.asarray(arr, np.asarray(tmpl).dtype))
+    return jax.tree.unflatten(treedef, new_leaves)
+
+
+class CheckpointManager:
+    """Numbered checkpoints in a directory: ckpt_<step>.npz, keep the newest
+    ``keep`` files."""
+
+    def __init__(self, directory: str, keep: int = 3) -> None:
+        self.directory = directory
+        self.keep = keep
+        os.makedirs(directory, exist_ok=True)
+
+    def _all(self):
+        out = []
+        for fn in os.listdir(self.directory):
+            m = _CKPT_RE.match(fn)
+            if m:
+                out.append((int(m.group(1)), os.path.join(self.directory, fn)))
+        return sorted(out)
+
+    def latest_path(self) -> Optional[str]:
+        all_ = self._all()
+        return all_[-1][1] if all_ else None
+
+    def save(self, state: Any, step_id: int) -> str:
+        path = os.path.join(self.directory, f"ckpt_{step_id}.npz")
+        save_state(path, state, step_id)
+        for _sid, p in self._all()[: -self.keep]:
+            os.unlink(p)
+        return path
+
+    def restore_latest(self, template: Any) -> Any:
+        path = self.latest_path()
+        if path is None:
+            return template
+        print(f"restoring checkpoint {path}")
+        return restore_state(path, template)

@@ -29,7 +29,9 @@ class ParallelConfig:
     num_classes: int = 10
     balance: Optional[Tuple[int, ...]] = None  # per-stage cell counts
     halo_d2: bool = False  # fused-halo "design 2"
-    fused_layers: int = 1  # convs per fused halo block in D2
+    # Margin-consuming layers per fused halo block in D2 (reference
+    # --fused-layers); 0 = fuse maximal runs (best: fewest exchanges).
+    fused_layers: int = 0
     local_dp_lp: int = 1  # LOCAL_DP_LP: DP degree inside LP stages
     slice_method: str = "square"  # square | vertical | horizontal
     app: int = 3  # 1=image folder, 2=cifar-like, 3=synthetic (reference APP)
@@ -97,7 +99,8 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-classes", type=int, default=10)
     p.add_argument("--balance", type=str, default=None)
     p.add_argument("--halo-d2", action="store_true")
-    p.add_argument("--fused-layers", type=int, default=1)
+    p.add_argument("--fused-layers", type=int, default=0,
+                   help="padded layers per fused D2 exchange; 0 = maximal")
     p.add_argument("--local-DP", dest="local_dp_lp", type=int, default=1)
     p.add_argument(
         "--slice-method",

@@ -124,6 +124,11 @@ class ImageFolderDataset:
         if path.endswith(".npy"):
             img = np.load(path)
         else:
+            from mpi4dl_tpu import data_native
+
+            native = data_native.load_rgb(path, self.image_size)
+            if native is not None:
+                return native
             raw = np.fromfile(path, dtype=np.uint8)
             side = int(math.isqrt(raw.size // 3))
             img = raw[: side * side * 3].reshape(side, side, 3).astype(np.float32) / 255.0

@@ -1,0 +1,131 @@
+"""ctypes bridge to the native C++ tile loader (native/tileloader.cc).
+
+Builds libtileloader.so with g++ on first use (cached next to the source, or
+under $MPI4DL_TPU_NATIVE_DIR) and exposes numpy-facing wrappers; every entry
+point degrades gracefully to None/False when no compiler is available, and
+data.py keeps a pure-numpy fallback, so the native path is an accelerator,
+never a hard dependency (pybind11 is not available in this environment —
+ctypes over an extern-C ABI is the binding layer)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _source_path() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "native",
+        "tileloader.cc",
+    )
+
+
+def _build(src: str, out: str) -> bool:
+    try:
+        r = subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", out, src],
+            capture_output=True,
+            timeout=120,
+        )
+        return r.returncode == 0 and os.path.exists(out)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded library, building it if needed; None when unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        src = _source_path()
+        if not os.path.exists(src):
+            return None
+        cache_dir = os.environ.get(
+            "MPI4DL_TPU_NATIVE_DIR", os.path.dirname(src)
+        )
+        so = os.path.join(cache_dir, "libtileloader.so")
+        if not (
+            os.path.exists(so)
+            and os.path.getmtime(so) >= os.path.getmtime(src)
+        ):
+            os.makedirs(cache_dir, exist_ok=True)
+            if not _build(src, so):
+                return None
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError:
+            return None
+        lib.tl_load_rgb.argtypes = [
+            ctypes.c_char_p, ctypes.c_int,
+            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+        ]
+        lib.tl_load_rgb.restype = ctypes.c_int
+        lib.tl_load_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
+            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+        ]
+        lib.tl_load_batch.restype = ctypes.c_int
+        lib.tl_crop_tiles.argtypes = [
+            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+        ]
+        lib.tl_crop_tiles.restype = None
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def load_rgb(path: str, image_size: int) -> Optional[np.ndarray]:
+    """Native load of one raw-RGB file → [S, S, 3] float32 in [0,1]."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    out = np.empty((image_size, image_size, 3), np.float32)
+    if lib.tl_load_rgb(path.encode(), image_size, out) != 0:
+        return None
+    return out
+
+
+def load_batch(paths: Sequence[str], image_size: int) -> Optional[np.ndarray]:
+    """Native load of a batch of raw-RGB files → [N, S, S, 3] float32."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(paths)
+    out = np.empty((n, image_size, image_size, 3), np.float32)
+    arr = (ctypes.c_char_p * n)(*[p.encode() for p in paths])
+    if lib.tl_load_batch(arr, n, image_size, out) != -1:
+        return None
+    return out
+
+
+def crop_tiles(
+    batch: np.ndarray, row: int, col: int, grid_h: int, grid_w: int
+) -> Optional[np.ndarray]:
+    """Native tile crop (host-side split_input analog): [N,H,W,C] → tile
+    (row, col) of a grid_h x grid_w grid."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    batch = np.ascontiguousarray(batch, np.float32)
+    n, h, w, c = batch.shape
+    out = np.empty((n, h // grid_h, w // grid_w, c), np.float32)
+    lib.tl_crop_tiles(batch, n, h, w, c, row, col, grid_h, grid_w, out)
+    return out

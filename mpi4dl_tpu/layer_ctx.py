@@ -50,6 +50,17 @@ class SpatialCtx:
     # the margin is already present, so convs skip their own exchange and run
     # VALID on the sharded dims.
     halo_pre_exchanged: bool = False
+    # Internal: the CURRENT margin (per sharded dim) carried by the activation
+    # inside a fused run — set per layer by the D2 drivers.  BatchNorm uses it
+    # to exclude the not-yet-consumed margin rows from its statistics (they
+    # duplicate neighbour rows / hold boundary zeros); pools to know their
+    # input is already extended.
+    pre_margin_h: int = 0
+    pre_margin_w: int = 0
+    # Cap on margin-consuming (padded) layers per fused run — the reference's
+    # --fused-layers knob (resnet_spatial_d2.py get_balance); None = fuse
+    # maximal runs (better: fewer exchanges).
+    d2_max_fused: Optional[int] = None
 
     @property
     def active(self) -> bool:
@@ -66,11 +77,26 @@ class ApplyCtx:
     ``spatial``:   spatial sharding description or None.
     ``data_axis``: mesh axis name for data parallelism (used only by layers
                    that want cross-replica stats; grads are psum'd outside).
+    ``bn_sink``:   when set (a plain dict, fresh per trace), BatchNorm layers
+                   deposit their UPDATED running statistics into it keyed by
+                   ``id()`` of the corresponding parameter leaf (the tracer
+                   object read from their params dict).  Step builders collect
+                   the sink into a leaf-aligned update list and write it back
+                   into the post-optimizer params — the JAX-functional form of
+                   torch BatchNorm2d's in-place running-buffer update
+                   (reference models use plain nn.BatchNorm2d,
+                   resnet_spatial.py:149-163).
     """
 
     train: bool = True
     spatial: Optional[SpatialCtx] = None
     data_axis: Optional[str] = None
+    bn_sink: Optional[dict] = None
+    # Extra mesh axes the activations vary over beyond spatial/data — e.g. the
+    # tile axes in the batch-split tail after an SP→LP junction (each former
+    # tile device holds a different batch shard).  Stat deposits pmean over
+    # these so written-back running stats stay replicated.
+    bn_stat_axes: tuple = ()
 
     def with_spatial(self, spatial: Optional[SpatialCtx]) -> "ApplyCtx":
         return dataclasses.replace(self, spatial=spatial)

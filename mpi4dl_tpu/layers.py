@@ -163,8 +163,12 @@ class BatchNorm(Layer):
     spatial layers, reference resnet_spatial.py:149-163); set
     ``bn_cross_tile=False`` on the SpatialCtx for that parity behaviour.
 
-    Running stats (`mean`,`var`) live in params but receive no gradient in
-    train mode; the simple trainer updates them via the aux path.
+    Running stats (`mean`,`var`) live in params; they receive no gradient in
+    train mode.  When ``ctx.bn_sink`` is set, train-mode apply() deposits the
+    momentum-updated running values (torch semantics: unbiased variance for
+    the running buffer) into the sink keyed by ``id()`` of the param leaves;
+    step builders write them back post-optimizer-update.  Eval mode
+    (``ctx.train=False``) normalizes with the running stats.
     """
 
     num_features: int
@@ -188,13 +192,25 @@ class BatchNorm(Layer):
         if ctx.train:
             axes = tuple(range(x.ndim - 1))  # all but channel
             sp = ctx.spatial
+            stat_x = xf
+            if sp is not None and sp.halo_pre_exchanged and (
+                sp.pre_margin_h or sp.pre_margin_w
+            ):
+                # Inside a D2 fused run the tile still carries not-yet-consumed
+                # margin rows (duplicated neighbour data / boundary zeros);
+                # statistics come from the true tile region only, so fused-run
+                # BN matches the unfused (and single-device) statistics
+                # exactly.  Normalisation still covers the full extended tile.
+                mh = sp.pre_margin_h if (sp.axis_h and sp.grid_h > 1) else 0
+                mw = sp.pre_margin_w if (sp.axis_w and sp.grid_w > 1) else 0
+                stat_x = xf[:, mh : xf.shape[1] - mh, mw : xf.shape[2] - mw, :]
+            cnt = jnp.asarray(
+                math.prod([stat_x.shape[a] for a in axes]), jnp.float32
+            )
             if sp is not None and sp.active and sp.bn_cross_tile:
                 # Cross-tile statistics: psum local (count, sum, sumsq).
-                cnt = jnp.array(
-                    math.prod([x.shape[a] for a in axes]), jnp.float32
-                )
-                s = jnp.sum(xf, axis=axes)
-                ss = jnp.sum(xf * xf, axis=axes)
+                s = jnp.sum(stat_x, axis=axes)
+                ss = jnp.sum(stat_x * stat_x, axis=axes)
                 ax_names = tuple(a for a in (sp.axis_h, sp.axis_w) if a)
                 cnt = lax.psum(cnt, ax_names)
                 s = lax.psum(s, ax_names)
@@ -202,20 +218,38 @@ class BatchNorm(Layer):
                 mean = s / cnt
                 var = ss / cnt - mean * mean
             else:
-                mean = jnp.mean(xf, axis=axes)
-                var = jnp.var(xf, axis=axes)
+                mean = jnp.mean(stat_x, axis=axes)
+                var = jnp.var(stat_x, axis=axes)
+            if ctx.bn_sink is not None:
+                self._deposit_running(params, mean, var, cnt, ctx)
         else:
             mean, var = params["mean"], params["var"]
         inv = lax.rsqrt(var + self.eps) * params["scale"]
         y = (xf - mean) * inv + params["bias"]
         return y.astype(orig_dtype)
 
-    def batch_stats(self, x, ctx: ApplyCtx):
-        """Return (mean, var) the way apply() computes them in train mode —
-        used by trainers that track running averages."""
-        axes = tuple(range(x.ndim - 1))
-        xf = x.astype(jnp.float32)
-        return jnp.mean(xf, axis=axes), jnp.var(xf, axis=axes)
+    def _deposit_running(self, params, mean, var, cnt, ctx: ApplyCtx):
+        """Put momentum-updated running stats into ctx.bn_sink.
+
+        Stats must come out replicated (params are replicated), so axes over
+        which the batch statistics still vary are pmean'd first: the data axis
+        always; the tile axes only when per-tile stats are in use
+        (bn_cross_tile=False — the psum'd cross-tile stats are already
+        tile-invariant).  The variance stored in the running buffer is the
+        unbiased one (torch nn.BatchNorm2d semantics)."""
+        sp = ctx.spatial
+        names = list(ctx.bn_stat_axes)
+        if sp is not None and sp.active and not sp.bn_cross_tile:
+            names += [a for a in (sp.axis_h, sp.axis_w) if a]
+        if ctx.data_axis:
+            names.append(ctx.data_axis)
+        if names:
+            mean = lax.pmean(mean, tuple(names))
+            var = lax.pmean(var, tuple(names))
+        unbiased = var * (cnt / jnp.maximum(cnt - 1.0, 1.0))
+        m = self.momentum
+        ctx.bn_sink[id(params["mean"])] = (1 - m) * params["mean"] + m * mean
+        ctx.bn_sink[id(params["var"])] = (1 - m) * params["var"] + m * unbiased
 
 
 # ---------------------------------------------------------------------------
@@ -361,6 +395,22 @@ class Pool2d(Layer):
         need_mask = (self.op == "avg" and not self.count_include_pad) or (
             self.op == "max" and (ph or pw)
         )
+
+        if sp is not None and sp.halo_pre_exchanged and (
+            (sharded_h and ph) or (sharded_w and pw)
+        ):
+            # Inside a D2 fused run: the margin (incl. this pool's padding) is
+            # already present, so run VALID on the sharded dims.  Pad-once D2
+            # semantics apply: boundary margin rows are zeros (no -inf mask,
+            # no in-bounds divisor on the sharded dims) — exactly what the
+            # pad-global-once emulation computes; the D1 path below keeps the
+            # exact global semantics.  Unsharded dims keep their own padding.
+            rem_ph = 0 if sharded_h else ph
+            rem_pw = 0 if sharded_w else pw
+            if self.op == "max":
+                return _window_reduce(x, kh, kw, sh, sw, rem_ph, rem_pw, "max")
+            ysum = _window_reduce(x, kh, kw, sh, sw, rem_ph, rem_pw, "add")
+            return ysum / jnp.asarray(kh * kw, x.dtype)
 
         if (sharded_h and ph) or (sharded_w and pw):
             halo_h = HaloSpec.symmetric(ph if sharded_h else 0)

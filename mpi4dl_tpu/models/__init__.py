@@ -6,14 +6,19 @@ __all__ = ["get_resnet_v1", "get_resnet_v2", "get_resnet", "amoebanetd"]
 
 def build_model(cfg):
     """Build the model named by cfg.model at cfg's geometry (the dispatch each
-    reference benchmark script performs inline)."""
+    reference benchmark script performs inline).
+
+    For resnet, ``cfg.num_layers`` is the block-count n of the v2 depth
+    formula 9n+2 (reference hardcodes n=12 → ResNet-110-v2 per benchmark,
+    benchmark_resnet_sp.py:161-163; pass --num-layers 12 for parity).  For
+    amoebanet it is the NAS cell count as in the reference parser."""
     from mpi4dl_tpu.utils import get_depth
 
     in_shape = (cfg.batch_size // cfg.parts, cfg.image_size, cfg.image_size, 3)
     if cfg.model == "resnet":
         return get_resnet(
             in_shape,
-            depth=get_depth(2, 12),
+            depth=get_depth(2, cfg.num_layers),
             num_classes=cfg.num_classes,
             version=2,
             softmax_in_model=cfg.softmax_in_model,

@@ -1,4 +1,4 @@
-"""D2 fused halo exchange: one accumulated exchange per conv run.
+"""D2 fused halo exchange: one accumulated exchange per layer run.
 
 The reference's "Design-2" replaces per-conv halo exchange with one larger
 exchange per block of ``fused_layers`` convs, the convs then running halo-free
@@ -12,16 +12,20 @@ of the SAME models:
   ``H = Σ_i p_i · Π_{j<i} s_j`` of a layer run (the receptive-field overlap of
   the whole run).
 - :func:`run_layers_d2` exchanges that margin ONCE, then applies each layer
-  with ``SpatialCtx.halo_pre_exchanged`` set, so convs run VALID on the
-  sharded dims and consume ``p_i`` margin each; margins stay divisible by
-  construction (``m_{i+1} = (m_i - p_i)/s_i`` with H built top-down).
+  with ``SpatialCtx.halo_pre_exchanged`` set and the layer's CURRENT margin in
+  ``pre_margin_h/w``, so convs/pools run VALID on the sharded dims and consume
+  ``p_i`` margin each; margins stay divisible by construction
+  (``m_{i+1} = (m_i - p_i)/s_i`` with H built top-down).
+- ``SpatialCtx.d2_max_fused`` caps the number of margin-consuming layers per
+  exchange (the reference's ``--fused-layers`` knob); None fuses maximal runs.
 
-Semantics note (same as the reference's D2): border numerics differ from the
-per-conv path — the global image is effectively zero-padded ONCE by H before
-the run, instead of re-padded at every conv; and normalisation layers inside
-a run see the not-yet-consumed margin rows.  A run whose first layers consume
-the margin before any BatchNorm (conv-first blocks) is bit-identical to D1.
-tests/test_d2.py pins both properties.
+Semantics notes (same trade as the reference's D2): the global image is
+effectively zero-padded ONCE by H before the run instead of re-padded at
+every conv, so border numerics of convs/pools differ from the per-conv D1
+path (pools see pad-once zeros on the sharded dims).  BatchNorm inside a
+fused run is EXACT, however: it excludes the not-yet-consumed margin rows
+from its statistics (layers.py), so cross-tile BN equals single-device BN
+whether or not a run is fused.  tests/test_d2.py pins these properties.
 """
 
 from __future__ import annotations
@@ -31,14 +35,18 @@ from typing import List, Optional, Sequence, Tuple
 import dataclasses
 
 from mpi4dl_tpu.layer_ctx import ApplyCtx
-from mpi4dl_tpu.layers import BatchNorm, Conv2d, Identity, ReLU, Softmax
+from mpi4dl_tpu.layers import BatchNorm, Conv2d, Identity, Pool2d, ReLU, Softmax
 from mpi4dl_tpu.ops.halo import HaloSpec, halo_exchange_2d
 
 
 def layer_d2_geometry(layer) -> Optional[Tuple[int, int, int, int]]:
     """(ph, pw, sh, sw) of a layer inside a fused run, or None when the layer
-    cannot participate (pools, dense — those runs fall back to per-op D1)."""
+    cannot participate (dense/flatten/head layers — those runs fall back to
+    per-op D1)."""
     if isinstance(layer, Conv2d):
+        kh, kw, sh, sw, ph, pw = layer._geometry()
+        return (ph, pw, sh, sw)
+    if isinstance(layer, Pool2d):
         kh, kw, sh, sw, ph, pw = layer._geometry()
         return (ph, pw, sh, sw)
     if isinstance(layer, (BatchNorm, ReLU, Identity, Softmax)):
@@ -77,6 +85,44 @@ def can_fuse(layers: Sequence, sp) -> bool:
     return (sharded_h and hh > 0) or (sharded_w and hw > 0)
 
 
+def apply_layers_premargin(layers: Sequence, params_seq, x, ctx: ApplyCtx,
+                           mh: int, mw: int):
+    """Apply `layers` to an activation already carrying margin (mh, mw) on the
+    sharded dims, consuming it layer by layer.  Returns (y, mh_out, mw_out).
+
+    Trace-time checks (ADVICE r1): each stride must divide both the remaining
+    margin and the true local extent, otherwise tiles would silently de-phase
+    relative to the pad-once global semantics."""
+    sp = ctx.spatial
+    sharded_h = bool(sp.axis_h) and sp.grid_h > 1
+    sharded_w = bool(sp.axis_w) and sp.grid_w > 1
+    for layer, p in zip(layers, params_seq):
+        ph, pw, sh, sw, *_ = layer_d2_geometry(layer)
+        sub = dataclasses.replace(
+            sp, halo_pre_exchanged=True, pre_margin_h=mh, pre_margin_w=mw
+        )
+        if sharded_h:
+            if (mh - ph) % sh or (x.shape[1] - 2 * mh) % sh:
+                raise ValueError(
+                    f"D2 stride misalignment on H: margin {mh}, pad {ph}, "
+                    f"stride {sh}, local extent {x.shape[1] - 2 * mh} — the "
+                    "tile would de-phase from the global conv grid; adjust "
+                    "tile grid / image size / fused run boundaries."
+                )
+        if sharded_w:
+            if (mw - pw) % sw or (x.shape[2] - 2 * mw) % sw:
+                raise ValueError(
+                    f"D2 stride misalignment on W: margin {mw}, pad {pw}, "
+                    f"stride {sw}, local extent {x.shape[2] - 2 * mw}."
+                )
+        x = layer.apply(p, x, ctx.with_spatial(sub))
+        if sharded_h:
+            mh = (mh - ph) // sh
+        if sharded_w:
+            mw = (mw - pw) // sw
+    return x, mh, mw
+
+
 def run_layers_d2(layers: Sequence, params_seq, x, ctx: ApplyCtx):
     """Apply a fused run: one accumulated halo exchange, then every layer in
     pre-exchanged (margin-consuming) mode."""
@@ -85,19 +131,38 @@ def run_layers_d2(layers: Sequence, params_seq, x, ctx: ApplyCtx):
     hh, hw = accumulated_halo(layers)
     sharded_h = bool(sp.axis_h) and sp.grid_h > 1
     sharded_w = bool(sp.axis_w) and sp.grid_w > 1
+    mh = hh if sharded_h else 0
+    mw = hw if sharded_w else 0
     x = halo_exchange_2d(
         x,
-        HaloSpec.symmetric(hh if sharded_h else 0),
-        HaloSpec.symmetric(hw if sharded_w else 0),
+        HaloSpec.symmetric(mh),
+        HaloSpec.symmetric(mw),
         sp.axis_h,
         sp.axis_w,
         sp.grid_h,
         sp.grid_w,
     )
-    sub_ctx = ctx.with_spatial(dataclasses.replace(sp, halo_pre_exchanged=True))
-    for layer, p in zip(layers, params_seq):
-        x = layer.apply(p, x, sub_ctx)
-    return x
+    y, mh_out, mw_out = apply_layers_premargin(layers, params_seq, x, ctx, mh, mw)
+    assert mh_out == 0 and mw_out == 0, (mh_out, mw_out)
+    return y
+
+
+def _chunk_runs(layers: Sequence, max_fused: Optional[int]) -> List[Tuple[int, int]]:
+    """Split [0, len) into runs each containing at most `max_fused`
+    margin-consuming (padded) layers; None = one run."""
+    n = len(layers)
+    if max_fused is None or max_fused <= 0:
+        return [(0, n)]
+    runs, start, used = [], 0, 0
+    for i, layer in enumerate(layers):
+        ph, pw, *_ = layer_d2_geometry(layer)
+        consumes = (ph > 0) or (pw > 0)
+        if consumes and used >= max_fused:
+            runs.append((start, i))
+            start, used = i, 0
+        used += 1 if consumes else 0
+    runs.append((start, n))
+    return [r for r in runs if r[0] < r[1]]
 
 
 def maybe_run_d2(layers: Sequence, params_seq, x, ctx: ApplyCtx):
@@ -111,5 +176,14 @@ def maybe_run_d2(layers: Sequence, params_seq, x, ctx: ApplyCtx):
         and not sp.halo_pre_exchanged
         and can_fuse(layers, sp)
     ):
-        return run_layers_d2(layers, params_seq, x, ctx)
+        x_out = x
+        for r0, r1 in _chunk_runs(layers, sp.d2_max_fused):
+            sub_layers = layers[r0:r1]
+            sub_params = params_seq[r0:r1]
+            if can_fuse(sub_layers, sp):
+                x_out = run_layers_d2(sub_layers, sub_params, x_out, ctx)
+            else:
+                for layer, p in zip(sub_layers, sub_params):
+                    x_out = layer.apply(p, x_out, ctx)
+        return x_out
     return None

@@ -40,7 +40,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 from mpi4dl_tpu.layer_ctx import ApplyCtx
 from mpi4dl_tpu.parallel.partition import StagePartition
 from mpi4dl_tpu.parallel.pipeline import PipelineState
-from mpi4dl_tpu.parallel.stage_common import gems_dual_scan, make_stage_branches
+from mpi4dl_tpu.parallel.stage_common import (
+    gems_dual_scan,
+    make_stage_branches,
+    scatter_stage_stats,
+)
 from mpi4dl_tpu.train import Optimizer
 
 
@@ -54,6 +58,7 @@ def make_gems_train_step(
     remat: bool = True,
     from_probs: bool = False,
     with_data_axis: bool = False,
+    bn_stats: bool = True,
 ):
     """Build the GEMS step: x is [2 * times * parts * mb, H, W, C]; the first
     half of each pair flows forward, the second backward."""
@@ -63,7 +68,8 @@ def make_gems_train_step(
     mirror_perm = [(i, S - 1 - i) for i in range(S)]
     grad_axes: Tuple[str, ...] = ("data",) if with_data_axis else ()
 
-    branches = make_stage_branches(part, ctx, compute_dtype, remat)
+    with_stats = bn_stats and part.stat_max > 0
+    branches = make_stage_branches(part, ctx, compute_dtype, remat, with_stats)
 
     def sharded_step(param_row, opt_state, x, labels):
         flat_params = param_row[0]
@@ -76,7 +82,7 @@ def make_gems_train_step(
         def loss_and_metrics(flat_params):
             # The reverse replica's params: device d gets stage S-1-d's row.
             mirror_params = lax.ppermute(flat_params, "stage", mirror_perm)
-            loss_acc, acc_acc = gems_dual_scan(
+            loss_acc, acc_acc, stA, stB = gems_dual_scan(
                 part, branches, flat_params, mirror_params, xs, ys,
                 vary_axes=("stage",) + grad_axes,
                 from_probs=from_probs,
@@ -88,14 +94,22 @@ def make_gems_train_step(
             if grad_axes:
                 loss = lax.pmean(loss, grad_axes)
                 acc = lax.pmean(acc, grad_axes)
-            return loss, acc
+            # Stream B's stats belong to stage S-1-d: route them home via the
+            # mirror permute, then average over all 2*times*Pn deposits (each
+            # stream contributed times*Pn).
+            stats = (stA + lax.ppermute(stB, "stage", mirror_perm)) / denom
+            return loss, (acc, stats)
 
-        (loss, acc), grads = jax.value_and_grad(loss_and_metrics, has_aux=True)(
-            flat_params
-        )
+        (loss, (acc, stats)), grads = jax.value_and_grad(
+            loss_and_metrics, has_aux=True
+        )(flat_params)
         if grad_axes:
             grads = lax.pmean(grads, grad_axes)
         new_flat, new_opt = optimizer.update(flat_params, grads, opt_state)
+        if with_stats:
+            if grad_axes:
+                stats = lax.pmean(stats, grad_axes)
+            new_flat = scatter_stage_stats(part, new_flat, stats)
         return new_flat[None], new_opt, {"loss": loss, "accuracy": acc}
 
     pspec = P("stage", None)

@@ -77,6 +77,52 @@ def lax_slice(vec, off: int, size: int):
     return jax.lax.slice_in_dim(vec, off, off + size)
 
 
+def stat_leaf_info(tree) -> Tuple[List[int], List[Tuple[int, int]]]:
+    """Locate BN running-stat leaves in a params tree.
+
+    Returns (leaf_ids, slots): ``leaf_ids`` are indices into the flattened
+    leaf list for every 'mean'/'var' entry of a dict that also carries
+    'scale' and 'bias' (the BatchNorm param signature — layers.py); ``slots``
+    are the matching (offset, size) ranges in the TreePack flat vector (flatten
+    order, offsets = cumulative leaf sizes).  This is what lets the flat-buffer
+    engines deposit running-stat updates back into their stage rows."""
+    from jax.tree_util import DictKey
+
+    leaves_with_path, _ = jax.tree_util.tree_flatten_with_path(tree)
+    parents: dict = {}
+    for path, _leaf in leaves_with_path:
+        if path and isinstance(path[-1], DictKey):
+            parents.setdefault(path[:-1], set()).add(path[-1].key)
+    bn_parents = {
+        p for p, ks in parents.items() if {"scale", "bias", "mean", "var"} <= ks
+    }
+    leaf_ids: List[int] = []
+    slots: List[Tuple[int, int]] = []
+    off = 0
+    for i, (path, leaf) in enumerate(leaves_with_path):
+        size = int(np.prod(leaf.shape)) if leaf.shape else 1
+        if (
+            path
+            and isinstance(path[-1], DictKey)
+            and path[-1].key in ("mean", "var")
+            and path[:-1] in bn_parents
+        ):
+            leaf_ids.append(i)
+            slots.append((off, size))
+        off += size
+    return leaf_ids, slots
+
+
+def stat_index_array(slots: Sequence[Tuple[int, int]], stat_max: int) -> np.ndarray:
+    """[stat_max] int32 flat positions for the slots, padded with -1."""
+    idx = np.full((stat_max,), -1, np.int32)
+    o = 0
+    for off, size in slots:
+        idx[o : o + size] = np.arange(off, off + size, dtype=np.int32)
+        o += size
+    return idx
+
+
 def pad_to(vec: jax.Array, n: int) -> jax.Array:
     if vec.shape[0] == n:
         return vec
@@ -99,6 +145,13 @@ class StagePartition:
     out_pack: TreePack  # output of last stage (logits)
     param_max: int
     act_max: int
+    # BN running-stat bookkeeping (see stat_leaf_info): per stage, the leaf
+    # indices + (offset, size) slots of mean/var inside the stage packing, and
+    # one [S, stat_max] -1-padded position table for the write-back scatter.
+    stat_leaf_ids: List[List[int]] = dataclasses.field(default_factory=list)
+    stat_slots: List[List[Tuple[int, int]]] = dataclasses.field(default_factory=list)
+    stat_max: int = 0
+    stat_idx: Optional[np.ndarray] = None  # [S, stat_max] int32
 
     @property
     def num_stages(self) -> int:
@@ -144,7 +197,21 @@ class StagePartition:
         out_pack = TreePack.of_struct(out_struct, compute_dtype)
         param_max = max(p.total for p in param_packs)
         act_max = max([p.total for p in act_packs] + [out_pack.total])
-        return cls(model, ranges, param_packs, act_packs, out_pack, param_max, act_max)
+        stat_leaf_ids, stat_slots = [], []
+        for r0, r1 in ranges:
+            ids, slots = stat_leaf_info([params_list[i] for i in range(r0, r1)])
+            stat_leaf_ids.append(ids)
+            stat_slots.append(slots)
+        stat_max = max((sum(sz for _, sz in s) for s in stat_slots), default=0)
+        stat_idx = (
+            np.stack([stat_index_array(s, stat_max) for s in stat_slots])
+            if stat_max
+            else None
+        )
+        return cls(
+            model, ranges, param_packs, act_packs, out_pack, param_max, act_max,
+            stat_leaf_ids, stat_slots, stat_max, stat_idx,
+        )
 
     # ---- parameter buffers ----
 

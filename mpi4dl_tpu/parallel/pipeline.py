@@ -39,7 +39,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from mpi4dl_tpu.layer_ctx import ApplyCtx
 from mpi4dl_tpu.parallel.partition import StagePartition
-from mpi4dl_tpu.parallel.stage_common import gpipe_scan, make_stage_branches
+from mpi4dl_tpu.parallel.stage_common import (
+    gpipe_scan,
+    make_stage_branches,
+    scatter_stage_stats,
+)
 from mpi4dl_tpu.train import Optimizer
 
 
@@ -67,6 +71,7 @@ def make_pipeline_train_step(
     from_probs: bool = False,
     with_data_axis: bool = False,
     loss_scale: float = 1.0,
+    bn_stats: bool = True,
 ):
     """Build `(PipelineState, x, labels) -> (PipelineState, metrics)`.
 
@@ -76,7 +81,8 @@ def make_pipeline_train_step(
     Pn = parts
     ctx = ApplyCtx(train=True)
 
-    branches = make_stage_branches(part, ctx, compute_dtype, remat)
+    with_stats = bn_stats and part.stat_max > 0
+    branches = make_stage_branches(part, ctx, compute_dtype, remat, with_stats)
 
     grad_axes: Tuple[str, ...] = ("data",) if with_data_axis else ()
 
@@ -88,7 +94,7 @@ def make_pipeline_train_step(
         y_parts = labels.reshape(Pn, mb)
 
         def loss_and_metrics(flat_params):
-            loss_acc, acc_acc = gpipe_scan(
+            loss_acc, acc_acc, st_acc = gpipe_scan(
                 part, branches, flat_params, x_parts, y_parts,
                 vary_axes=("stage",) + grad_axes,
                 from_probs=from_probs,
@@ -101,17 +107,21 @@ def make_pipeline_train_step(
             if grad_axes:
                 loss = lax.pmean(loss, grad_axes)
                 acc = lax.pmean(acc, grad_axes)
-            return loss * loss_scale, acc
+            return loss * loss_scale, (acc, st_acc / Pn)
 
-        (loss, acc), grads = jax.value_and_grad(loss_and_metrics, has_aux=True)(
-            flat_params
-        )
+        (loss, (acc, stats)), grads = jax.value_and_grad(
+            loss_and_metrics, has_aux=True
+        )(flat_params)
         if loss_scale != 1.0:
             grads = grads / loss_scale
             loss = loss / loss_scale
         if grad_axes:
             grads = lax.pmean(grads, grad_axes)
         new_flat, new_opt = optimizer.update(flat_params, grads, opt_state)
+        if with_stats:
+            if grad_axes:
+                stats = lax.pmean(stats, grad_axes)
+            new_flat = scatter_stage_stats(part, new_flat, stats)
         return new_flat[None], new_opt, {"loss": loss, "accuracy": acc}
 
     pspec = P("stage", None)

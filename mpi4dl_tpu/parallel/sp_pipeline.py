@@ -29,14 +29,20 @@ branches must be pure compute):
   whole program: the junction gathers transpose into the tile/stage scatter
   of cotangents the reference implements by hand.
 
-Gradient combine (derived from the collective transposes; validated exactly
-against single-device SGD in tests/test_sp_pipeline.py):
+Gradient combine — DERIVATION (validated exactly against single-device SGD
+in tests/test_sp_pipeline.py for both junctions):
 
-- tail stage rows: pmean over tile axes (+ data),
-- spatial params (replicated): pmean over ``stage`` and tile axes (+ data) —
-  each device's cotangent of the fully-reduced loss already carries the
-  global psum-broadcast, so combining is an average everywhere (empirically
-  calibrated: a psum over ``stage`` double-counts by exactly S).
+shard_map's AD reduces the cotangent of an axis-INVARIANT input itself: when
+a replicated value (sp params, in_specs P(); tail rows, invariant over the
+tile/data axes) feeds axis-varying compute, the transpose inserts the
+cross-device psum so the returned cotangent is again invariant — including
+the contributions routed home by the junction all_gather's adjoint
+(reduce-scatter) and the ppermute transposes.  Each device's ``g_sp`` /
+``g_tail`` therefore already IS the complete gradient of the
+mean-over-devices loss.  The explicit ``pmean``s below are numerically the
+identity on these already-reduced values — they exist to make the invariance
+explicit (vma bookkeeping), not to combine anything; this is also why a
+``psum`` over ``stage`` would multiply the gradient by exactly S.
 """
 
 from __future__ import annotations
@@ -51,7 +57,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from mpi4dl_tpu.cells import CellModel
 from mpi4dl_tpu.layer_ctx import ApplyCtx, SpatialCtx
-from mpi4dl_tpu.parallel.partition import StagePartition, TreePack, pad_to
+import numpy as np
+
+from mpi4dl_tpu.parallel.partition import (
+    StagePartition,
+    TreePack,
+    pad_to,
+    stat_leaf_info,
+)
 from mpi4dl_tpu.parallel.spatial import (
     gather_spatial,
     scatter_batch_over_tiles,
@@ -61,6 +74,7 @@ from mpi4dl_tpu.parallel.stage_common import (
     gems_dual_scan,
     gpipe_scan,
     make_stage_branches,
+    scatter_stage_stats,
 )
 from mpi4dl_tpu.train import Optimizer, spatial_partition_spec
 
@@ -76,6 +90,11 @@ class SPPipeline:
     tail_part: StagePartition  # pipeline partition of the tail cells
     junction: str  # 'gather' | 'batch_split'
     mb_tail: int  # per-device tail micro-batch
+    # BN running-stat positions inside the spatial-region packing (the tail's
+    # live in tail_part.stat_*): leaf indices into the unpacked tree + flat
+    # positions in sp_buf for the write-back.
+    sp_stat_leaf_ids: list = dataclasses.field(default_factory=list)
+    sp_stat_idx: Optional[np.ndarray] = None
 
     @classmethod
     def build(
@@ -121,7 +140,17 @@ class SPPipeline:
             balance=balance, compute_dtype=compute_dtype,
         )
         sp_pack = TreePack.of(params_list[:su])
-        return cls(model, su, sp, sp_pack, tail_part, junction, mb_tail)
+        sp_ids, sp_slots = stat_leaf_info(params_list[:su])
+        sp_idx = (
+            np.concatenate(
+                [np.arange(o, o + s, dtype=np.int32) for o, s in sp_slots]
+            )
+            if sp_slots
+            else None
+        )
+        return cls(
+            model, su, sp, sp_pack, tail_part, junction, mb_tail, sp_ids, sp_idx
+        )
 
     def pack_spatial(self, params_list) -> jax.Array:
         return self.sp_pack.pack(params_list[: self.spatial_until])
@@ -173,6 +202,7 @@ def _make_sp_step(
     compute_dtype,
     remat: bool,
     with_data_axis: bool,
+    bn_stats: bool = True,
 ):
     """Shared scaffolding of the SP(+GEMS) x PP steps: phase-1 spatial region,
     junction, tail scan (``scan_fn``), loss reduction, grad combine, update.
@@ -180,7 +210,13 @@ def _make_sp_step(
     ``lead_shape`` shapes the injection pytree's leading dims —
     ``(Pn,)`` for GPipe, ``(times, 2, Pn)`` for the GEMS dual stream.
     ``scan_fn(branches, tail_flat, x_parts, y_parts, vary_axes)`` returns the
-    boundary-stage (loss_acc, acc_acc); ``denom`` is the drained part count.
+    boundary-stage (loss_acc, acc_acc, stats_avg); ``denom`` is the drained
+    part count.
+
+    BN running stats: the spatial region deposits once per step over the full
+    per-device chunk (coarser batch-stat granularity than the per-micro-batch
+    reference semantics — a documented, statistically stronger deviation); the
+    tail deposits per valid tick via the scan, engine-normalized in scan_fn.
     """
     sp = spp.sp
     part = spp.tail_part
@@ -195,11 +231,16 @@ def _make_sp_step(
     sp_ctx = ApplyCtx(train=True, spatial=sp)
     tail_ctx = ApplyCtx(train=True)
 
-    branches = make_stage_branches(part, tail_ctx, compute_dtype, remat)
+    with_stats_sp = bn_stats and bool(spp.sp_stat_leaf_ids)
+    with_stats_tail = bn_stats and part.stat_max > 0
+    branches = make_stage_branches(
+        part, tail_ctx, compute_dtype, remat, with_stats_tail
+    )
 
     def phase1(sp_flat, x_tile):
         """Spatial region on this device's (stage-chunk, tile): returns the
-        tail injection pytree [*lead_shape, mb_tail, ...] in batch order."""
+        tail injection pytree [*lead_shape, mb_tail, ...] in batch order,
+        plus the spatial region's BN stat-update vector."""
         B = x_tile.shape[0]
         assert B % S == 0, f"batch {B} must divide over {S} stage blocks"
         chunk = B // S
@@ -214,11 +255,24 @@ def _make_sp_step(
         params_sp = spp.sp_pack.unpack(sp_flat)
 
         def region(ps, xx):
-            return spp.model.apply(ps, xx, sp_ctx, start=0, stop=su)
+            if with_stats_sp:
+                sink: dict = {}
+                c = dataclasses.replace(sp_ctx, bn_sink=sink)
+            else:
+                sink, c = None, sp_ctx
+            act = spp.model.apply(ps, xx, c, start=0, stop=su)
+            if not with_stats_sp:
+                return act, jnp.zeros((0,), jnp.float32)
+            leaves = jax.tree.leaves(ps)
+            vals = [
+                sink.get(id(leaves[i]), leaves[i]) for i in spp.sp_stat_leaf_ids
+            ]
+            svec = jnp.concatenate([jnp.ravel(v).astype(jnp.float32) for v in vals])
+            return act, svec
 
         if remat:
             region = jax.checkpoint(region)
-        act = region(params_sp, xs.astype(compute_dtype))
+        act, sp_stats = region(params_sp, xs.astype(compute_dtype))
         # Junction: mosaic-merge tiles; batch-split for LOCAL_DP_LP.
         act = gather_spatial(act, sp)
         if spp.junction == "batch_split":
@@ -229,7 +283,7 @@ def _make_sp_step(
             t = lax.all_gather(t, "stage", axis=0, tiled=True)
             return t.reshape(*lead_shape, spp.mb_tail, *t.shape[1:])
 
-        return jax.tree.map(g, act)
+        return jax.tree.map(g, act), sp_stats
 
     def labels_to_parts(labels):
         """The same index transform phase1 applies to images (chunk by stage
@@ -251,8 +305,8 @@ def _make_sp_step(
         vary_axes = ("stage",) + tile_axes + grad_axes
 
         def loss_and_metrics(sp_flat, tail_flat):
-            x_parts = phase1(sp_flat, x)
-            loss_acc, acc_acc = scan_fn(
+            x_parts, sp_stats = phase1(sp_flat, x)
+            loss_acc, acc_acc, tail_stats = scan_fn(
                 branches, tail_flat, x_parts, y_parts, vary_axes
             )
             loss = lax.psum(loss_acc, "stage") / denom
@@ -263,13 +317,14 @@ def _make_sp_step(
             if grad_axes:
                 loss = lax.pmean(loss, grad_axes)
                 acc = lax.pmean(acc, grad_axes)
-            return loss, acc
+            return loss, (acc, sp_stats, tail_stats)
 
-        (loss, acc), (g_sp, g_tail) = jax.value_and_grad(
+        (loss, (acc, sp_stats, tail_stats)), (g_sp, g_tail) = jax.value_and_grad(
             loss_and_metrics, argnums=(0, 1), has_aux=True
         )(sp_buf, tail_flat)
 
-        # Collective-transpose bookkeeping (see module docstring):
+        # Identity-on-value invariance bookkeeping (derivation in the module
+        # docstring: AD already psum'd these cotangents home):
         g_sp = lax.pmean(g_sp, "stage")
         if tile_axes:
             g_sp = lax.pmean(g_sp, tile_axes)
@@ -280,6 +335,24 @@ def _make_sp_step(
 
         new_sp, new_opt_sp = optimizer.update(sp_buf, g_sp, opt_sp)
         new_tail, new_opt_tail = optimizer.update(tail_flat, g_tail, opt_tail)
+        if with_stats_sp:
+            # Spatial stats vary over stage (distinct batch chunks) and data;
+            # the tile axes are already reduced inside BN (cross-tile psum) or
+            # the deposit (per-tile pmean).  sp_buf is fully replicated.
+            st = lax.pmean(sp_stats, ("stage",) + grad_axes)
+            new_sp = new_sp.at[jnp.asarray(spp.sp_stat_idx)].set(
+                st.astype(new_sp.dtype)
+            )
+        if with_stats_tail:
+            # Tail stats vary over the tile axes under junction='batch_split'
+            # (distinct batch shards) and over data; identical over tiles
+            # under 'gather' (pmean harmless).
+            stt = tail_stats
+            if tile_axes:
+                stt = lax.pmean(stt, tile_axes)
+            if grad_axes:
+                stt = lax.pmean(stt, grad_axes)
+            new_tail = scatter_stage_stats(part, new_tail, stt)
         return (
             new_sp,
             new_tail[None],
@@ -320,6 +393,7 @@ def make_sp_pipeline_train_step(
     remat: bool = True,
     from_probs: bool = False,
     with_data_axis: bool = False,
+    bn_stats: bool = True,
 ):
     """Build `(SPPipelineState, x, labels) -> (SPPipelineState, metrics)`.
 
@@ -331,16 +405,17 @@ def make_sp_pipeline_train_step(
     part = spp.tail_part
 
     def scan_fn(branches, tail_flat, x_parts, y_parts, vary_axes):
-        return gpipe_scan(
+        loss_acc, acc_acc, st_acc = gpipe_scan(
             part, branches, tail_flat, x_parts, y_parts,
             vary_axes=vary_axes,
             from_probs=from_probs,
             compute_dtype=compute_dtype,
         )
+        return loss_acc, acc_acc, st_acc / parts
 
     return _make_sp_step(
         spp, optimizer, mesh, (parts,), scan_fn, parts,
-        compute_dtype, remat, with_data_axis,
+        compute_dtype, remat, with_data_axis, bn_stats,
     )
 
 
@@ -354,6 +429,7 @@ def make_sp_gems_train_step(
     remat: bool = True,
     from_probs: bool = False,
     with_data_axis: bool = False,
+    bn_stats: bool = True,
 ):
     """SP x GEMS x PP — the reference's flagship 5D composition
     (``train_spatial_master.py``: two spatial models over mirrored rank sets
@@ -369,14 +445,16 @@ def make_sp_gems_train_step(
 
     def scan_fn(branches, tail_flat, x_parts, y_parts, vary_axes):
         mirror_params = lax.ppermute(tail_flat, "stage", mirror_perm)
-        return gems_dual_scan(
+        loss_acc, acc_acc, stA, stB = gems_dual_scan(
             part, branches, tail_flat, mirror_params, x_parts, y_parts,
             vary_axes=vary_axes,
             from_probs=from_probs,
             compute_dtype=compute_dtype,
         )
+        st = (stA + lax.ppermute(stB, "stage", mirror_perm)) / (2 * times * parts)
+        return loss_acc, acc_acc, st
 
     return _make_sp_step(
         spp, optimizer, mesh, (times, 2, parts), scan_fn, 2 * times * parts,
-        compute_dtype, remat, with_data_axis,
+        compute_dtype, remat, with_data_axis, bn_stats,
     )

@@ -101,5 +101,15 @@ def apply_spatial_model(
     x = gather_spatial(x, sp)
     if junction == "batch_split":
         x = scatter_batch_over_tiles(x, sp)
-    tail_ctx = ctx.with_spatial(None)
+    # BN running-stat deposits in the tail must pmean over the former tile
+    # axes: under 'batch_split' the batch genuinely varies per tile device;
+    # under 'gather' the all_gathered values are equal but shard_map's
+    # varying-axes tracking cannot know that, so the (numerically no-op)
+    # pmean re-establishes provable replication.
+    import dataclasses
+
+    tile_axes = tuple(a for a in (sp.axis_h, sp.axis_w) if a)
+    tail_ctx = dataclasses.replace(
+        ctx.with_spatial(None), bn_stat_axes=ctx.bn_stat_axes + tile_axes
+    )
     return model.apply(params_list, x, tail_ctx, start=spatial_until)

@@ -13,6 +13,7 @@ structural reason the SP region runs as a separate uniform phase in
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Callable, List, Optional, Tuple
 
 import jax
@@ -29,19 +30,49 @@ def make_stage_branches(
     ctx: ApplyCtx,
     compute_dtype,
     remat: bool,
+    with_stats: bool = False,
 ) -> List[Callable]:
     """One pure-compute branch per stage: unpack flat activation → run the
     stage's cells → pack/pad the output activation (reference per-rank
-    sub-model forward, mp_pipeline.py:434-473)."""
+    sub-model forward, mp_pipeline.py:434-473).
+
+    Every branch returns ``(act_out [act_max], stats [stat_max])`` — the
+    second element carries the stage's UPDATED BN running stats (fp32, in the
+    stage packing's slot order, zero-padded) when ``with_stats``; callers mask
+    out bubble-tick garbage and scatter the average back into the stage's
+    flat param row.  stat_max may be 0 (no BN / stats disabled)."""
+    stat_n = part.stat_max if with_stats else 0
 
     def stage_branch(s: int):
         pk_in = part.act_packs[s]
         out_pk = part.act_packs[s + 1] if s + 1 < part.num_stages else part.out_pack
+        pkp = part.param_packs[s]
+        r0, r1 = part.ranges[s]
 
         def fn(flat_params, buf):
             act = pk_in.unpack(lax_slice(buf, 0, pk_in.total), dtype=compute_dtype)
-            y = part.stage_apply(s, flat_params, act, ctx)
-            return pad_to(out_pk.pack(y, compute_dtype), part.act_max)
+            params = pkp.unpack(lax_slice(flat_params, 0, pkp.total))
+            if stat_n:
+                sink: dict = {}
+                c = dataclasses.replace(ctx, bn_sink=sink)
+            else:
+                sink, c = None, ctx
+            y = act
+            for i in range(r0, r1):
+                y = part.model.cells[i].apply(params[i - r0], y, c)
+            out = pad_to(out_pk.pack(y, compute_dtype), part.act_max)
+            if not stat_n:
+                return out, jnp.zeros((0,), jnp.float32)
+            leaves = jax.tree.leaves(params)
+            vals = [
+                sink.get(id(leaves[i]), leaves[i]) for i in part.stat_leaf_ids[s]
+            ]
+            svec = (
+                jnp.concatenate([jnp.ravel(v).astype(jnp.float32) for v in vals])
+                if vals
+                else jnp.zeros((0,), jnp.float32)
+            )
+            return out, pad_to(svec, stat_n)
 
         return jax.checkpoint(fn) if remat else fn
 
@@ -62,11 +93,14 @@ def gpipe_scan(
     """The GPipe tick loop (reference run_step, mp_pipeline.py:509-534).
 
     x_parts: [Pn, mb, ...] micro-batch inputs of stage 0 (device-local);
-    y_parts: [Pn, mb] labels.  Returns (loss_acc, acc_acc) accumulated ONLY on
-    the last stage's devices over the Pn drained parts — callers psum over
-    'stage' and normalise.  T = Pn + S - 1 ticks; activations advance one
-    stage per tick via a non-wrapping ppermute; the backward pass is the AD
-    transpose of this scan (all-forwards-then-all-backwards falls out).
+    y_parts: [Pn, mb] labels.  Returns (loss_acc, acc_acc, stats_acc):
+    loss/acc accumulated ONLY on the last stage's devices over the Pn drained
+    parts — callers psum over 'stage' and normalise; stats_acc is the sum of
+    the stage's BN running-stat updates over its Pn VALID compute ticks
+    (bubble ticks masked out) — callers divide by Pn and scatter into the
+    stage param row.  T = Pn + S - 1 ticks; activations advance one stage per
+    tick via a non-wrapping ppermute; the backward pass is the AD transpose of
+    this scan (all-forwards-then-all-backwards falls out).
     """
     S = part.num_stages
     lead = jax.tree.leaves(x_parts)[0]
@@ -78,16 +112,20 @@ def gpipe_scan(
     logits_n = part.out_pack.total
     nclass = part.out_pack.shapes[0][-1]
     amax = part.act_max
+    stat_n = branches_stat_n(branches, part)
 
     def tick(carry, t):
-        buf, loss_acc, acc_acc = carry
+        buf, loss_acc, acc_acc, st_acc = carry
         p_in = jnp.clip(t, 0, Pn - 1)
         xp = jax.tree.map(
             lambda a: lax.dynamic_index_in_dim(a, p_in, keepdims=False), x_parts
         )
         inj = pad_to(in_pack0.pack(xp, compute_dtype), amax)
         buf = jnp.where(s_idx == 0, inj, buf)
-        y = lax.switch(s_idx, branches, flat_params, buf)
+        y, st = lax.switch(s_idx, branches, flat_params, buf)
+        # Stage s computes part p = t - s; stats only count on valid ticks.
+        st_valid = (t >= s_idx) & (t - s_idx < Pn)
+        st_acc = st_acc + jnp.where(st_valid, st, 0.0)
         # Last stage: loss for part p = t - (S-1) when in range.
         p_out = t - (S - 1)
         valid = (p_out >= 0) & (p_out < Pn) & is_last
@@ -102,7 +140,7 @@ def gpipe_scan(
         # Hand activations to the next stage (non-wrap: stage 0's stale recv
         # is overwritten by injection next tick).
         buf = lax.ppermute(y, "stage", [(i, i + 1) for i in range(S - 1)])
-        return (buf, loss_acc, acc_acc), None
+        return (buf, loss_acc, acc_acc, st_acc), None
 
     # Initial carries must be marked varying over the axes the loop makes
     # them vary on, or shard_map's AD produces wrong collective transposes
@@ -111,10 +149,39 @@ def gpipe_scan(
         return lax.pcast(t, vary_axes, to="varying")
 
     buf0 = v(jnp.zeros((amax,), compute_dtype))
-    (_, loss_acc, acc_acc), _ = lax.scan(
-        tick, (buf0, v(jnp.zeros(())), v(jnp.zeros(()))), jnp.arange(T)
+    st0 = v(jnp.zeros((stat_n,), jnp.float32))
+    (_, loss_acc, acc_acc, stats_acc), _ = lax.scan(
+        tick, (buf0, v(jnp.zeros(())), v(jnp.zeros(())), st0), jnp.arange(T)
     )
-    return loss_acc, acc_acc
+    return loss_acc, acc_acc, stats_acc
+
+
+def scatter_stage_stats(part: StagePartition, flat: jax.Array, stats: jax.Array):
+    """Scatter averaged BN running-stat values into this device's stage param
+    row.  ``stats`` is the [stat_max] vector in the stage's slot order (from
+    gpipe_scan / gems_dual_scan, already divided by the part count); positions
+    come from the -1-padded part.stat_idx table indexed by the device's stage.
+    Padded entries resolve to a masked add of 0 at position 0, so the scatter
+    is uniform across heterogeneous stages."""
+    if part.stat_idx is None:
+        return flat
+    idx_all = jnp.asarray(part.stat_idx)  # [S, stat_max]
+    row = lax.dynamic_index_in_dim(idx_all, lax.axis_index("stage"), keepdims=False)
+    mask = row >= 0
+    safe = jnp.where(mask, row, 0)
+    cur = flat[safe]
+    return flat.at[safe].add(jnp.where(mask, stats.astype(flat.dtype) - cur, 0.0))
+
+
+def branches_stat_n(branches, part: StagePartition) -> int:
+    """Static stats-vector length the branches were built with (0 or
+    part.stat_max — probed abstractly so callers stay in sync)."""
+    out = jax.eval_shape(
+        branches[0],
+        jax.ShapeDtypeStruct((part.param_max,), jnp.float32),
+        jax.ShapeDtypeStruct((part.act_max,), jnp.float32),
+    )
+    return int(out[1].shape[0])
 
 
 def gems_dual_scan(
@@ -136,8 +203,11 @@ def gems_dual_scan(
     params; stream B flows S-1→0 against ``mirror_params`` (device d holding
     stage S-1-d's row via the mirror ppermute) — the two switch branches per
     tick are what XLA interleaves into bidirectional bubble-filling.  Returns
-    (loss_acc, acc_acc) accumulated on the boundary stages over all
-    2·times·Pn drained parts; callers psum over 'stage' and normalise.
+    (loss_acc, acc_acc, statsA_acc, statsB_acc): loss/acc accumulated on the
+    boundary stages over all 2·times·Pn drained parts (callers psum over
+    'stage' and normalise); statsA_acc holds device d's stage-d BN stat
+    updates from the forward stream, statsB_acc its stage-(S-1-d) updates from
+    the reverse stream — callers mirror-ppermute B, average, and scatter.
     """
     S = part.num_stages
     lead = jax.tree.leaves(x_groups)[0]
@@ -148,6 +218,7 @@ def gems_dual_scan(
     logits_n = part.out_pack.total
     nclass = part.out_pack.shapes[0][-1]
     amax = part.act_max
+    stat_n = branches_stat_n(branches, part)
     fwd_perm = [(i, i + 1) for i in range(S - 1)]
     bwd_perm = [(i + 1, i) for i in range(S - 1)]
 
@@ -155,7 +226,7 @@ def gems_dual_scan(
         return lax.pcast(t, vary_axes, to="varying")
 
     def one_pair(carry, pair):
-        loss_in, acc_in = carry
+        loss_in, acc_in, stA_in, stB_in = carry
         xp, yp = pair  # leaves [2, Pn, mb, ...], [2, Pn, mb]
 
         def sel(tree, j, p):
@@ -167,14 +238,21 @@ def gems_dual_scan(
             )
 
         def tick(c, t):
-            bufA, bufB, l_acc, a_acc = c
+            bufA, bufB, l_acc, a_acc, stA, stB = c
             p_in = jnp.clip(t, 0, Pn - 1)
             injA = pad_to(in_pack0.pack(sel(xp, 0, p_in), compute_dtype), amax)
             injB = pad_to(in_pack0.pack(sel(xp, 1, p_in), compute_dtype), amax)
             bufA = jnp.where(d == 0, injA, bufA)
             bufB = jnp.where(d == S - 1, injB, bufB)
-            yA = lax.switch(d, branches, flat_params, bufA)
-            yB = lax.switch(S - 1 - d, branches, mirror_params, bufB)
+            yA, sA = lax.switch(d, branches, flat_params, bufA)
+            yB, sB = lax.switch(S - 1 - d, branches, mirror_params, bufB)
+            # Stream A: device d runs stage d on part t-d; stream B: device d
+            # runs stage S-1-d, which part p enters at tick p+(S-1-d)... i.e.
+            # processes part t-(S-1-d).
+            vA = (t >= d) & (t - d < Pn)
+            vB = (t >= (S - 1 - d)) & (t - (S - 1 - d) < Pn)
+            stA = stA + jnp.where(vA, sA, 0.0)
+            stB = stB + jnp.where(vB, sB, 0.0)
             p_out = t - (S - 1)
             in_range = (p_out >= 0) & (p_out < Pn)
             p_sel = jnp.clip(p_out, 0, Pn - 1)
@@ -196,18 +274,23 @@ def gems_dual_scan(
             )
             bufA = lax.ppermute(yA, "stage", fwd_perm)
             bufB = lax.ppermute(yB, "stage", bwd_perm)
-            return (bufA, bufB, l_acc, a_acc), None
+            return (bufA, bufB, l_acc, a_acc, stA, stB), None
 
         init = (
             v(jnp.zeros((amax,), compute_dtype)),
             v(jnp.zeros((amax,), compute_dtype)),
             v(jnp.zeros(())),
             v(jnp.zeros(())),
+            stA_in,
+            stB_in,
         )
-        (_, _, l_acc, a_acc), _ = lax.scan(tick, init, jnp.arange(T))
-        return (loss_in + l_acc, acc_in + a_acc), None
+        (_, _, l_acc, a_acc, stA, stB), _ = lax.scan(tick, init, jnp.arange(T))
+        return (loss_in + l_acc, acc_in + a_acc, stA, stB), None
 
-    (loss_acc, acc_acc), _ = lax.scan(
-        one_pair, (v(jnp.zeros(())), v(jnp.zeros(()))), (x_groups, y_groups)
+    st0 = v(jnp.zeros((stat_n,), jnp.float32))
+    (loss_acc, acc_acc, stA_acc, stB_acc), _ = lax.scan(
+        one_pair,
+        (v(jnp.zeros(())), v(jnp.zeros(())), st0, v(jnp.zeros((stat_n,), jnp.float32))),
+        (x_groups, y_groups),
     )
-    return loss_acc, acc_acc
+    return loss_acc, acc_acc, stA_acc, stB_acc

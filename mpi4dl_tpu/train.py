@@ -116,12 +116,38 @@ class TrainState:
 # ---------------------------------------------------------------------------
 
 
-def make_loss_fn(model: CellModel, ctx: ApplyCtx, from_probs: bool = False):
+def stat_updates_from_sink(sink: Optional[dict], params) -> Optional[list]:
+    """Collect a bn_sink into a list aligned with the flattened param leaves
+    (None where a leaf has no running-stat update — None is an empty pytree
+    node, so the list is a valid jit/scan-carry aux with static structure)."""
+    if sink is None:
+        return None
+    return [sink.get(id(leaf)) for leaf in jax.tree.leaves(params)]
+
+
+def merge_stat_updates(params, updates: Optional[list]):
+    """Write collected running-stat updates back into a params tree (typically
+    the post-optimizer one — the functional analog of torch BN's in-place
+    running-buffer mutation)."""
+    if updates is None or all(u is None for u in updates):
+        return params
+    leaves, treedef = jax.tree.flatten(params)
+    merged = [l if u is None else u.astype(l.dtype) for l, u in zip(leaves, updates)]
+    return jax.tree.unflatten(treedef, merged)
+
+
+def make_loss_fn(model: CellModel, ctx: ApplyCtx, from_probs: bool = False,
+                 remat: bool = False, with_stats: bool = False):
+    """Loss fn returning ``(loss, (logits, stat_updates))``; stat_updates is
+    None unless with_stats (then a leaf-aligned BN running-stat update list)."""
+
     def loss_fn(params_list, x, labels):
-        logits = model.apply(params_list, x, ctx)
+        c = dataclasses.replace(ctx, bn_sink={}) if with_stats else ctx
+        logits = model.apply(params_list, x, c, remat=remat)
         if isinstance(logits, tuple):
             logits = logits[0]
-        return cross_entropy(logits, labels, from_probs), logits
+        stats = stat_updates_from_sink(c.bn_sink, params_list) if with_stats else None
+        return cross_entropy(logits, labels, from_probs), (logits, stats)
 
     return loss_fn
 
@@ -133,42 +159,62 @@ def make_train_step(
     parts: int = 1,
     compute_dtype=jnp.float32,
     from_probs: bool = False,
+    remat: bool = False,
+    bn_stats: bool = True,
 ):
     """Single-device or DP (batch sharded over 'data') training step.
 
     `parts` > 1 runs the micro-batch gradient-accumulation loop via lax.scan —
     the degenerate (split_size=1) form of the reference's GPipe parts loop.
+    `remat=True` checkpoints per cell (memory for FLOPs — required for the
+    reference's high-resolution configs at batch 1 on one chip).
+    `bn_stats=True` (default) updates BN running statistics each step (torch
+    nn.BatchNorm2d semantics; with parts>1 the update uses the batch stats
+    averaged over microbatches, which the momentum rule makes equivalent to
+    averaging the per-microbatch updated values).
     """
     ctx = ApplyCtx(train=True)
-    loss_fn = make_loss_fn(model, ctx, from_probs)
+    loss_fn = make_loss_fn(model, ctx, from_probs, remat=remat, with_stats=bn_stats)
 
     def grads_for(params, x, labels):
-        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        (loss, (logits, stats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             params, x.astype(compute_dtype), labels
         )
-        return loss, logits, grads
+        return loss, logits, stats, grads
 
     def step(state: TrainState, x, labels):
         if parts == 1:
-            loss, logits, grads = grads_for(state.params, x, labels)
+            loss, logits, stats, grads = grads_for(state.params, x, labels)
             acc = accuracy(logits, labels)
         else:
             mb_x = x.reshape(parts, x.shape[0] // parts, *x.shape[1:])
             mb_y = labels.reshape(parts, labels.shape[0] // parts)
             zero = jax.tree.map(jnp.zeros_like, state.params)
+            # Abstract probe for the (static) stat-update structure.
+            stats_struct = jax.eval_shape(
+                grads_for, state.params, mb_x[0], mb_y[0]
+            )[2]
+            stats_zero = jax.tree.map(
+                lambda s: jnp.zeros(s.shape, s.dtype), stats_struct
+            )
 
             def body(carry, mb):
-                g_acc, loss_acc, acc_acc = carry
-                loss, logits, grads = grads_for(state.params, mb[0], mb[1])
+                g_acc, loss_acc, acc_acc, st_acc = carry
+                loss, logits, stats, grads = grads_for(state.params, mb[0], mb[1])
                 g_acc = jax.tree.map(jnp.add, g_acc, grads)
-                return (g_acc, loss_acc + loss, acc_acc + accuracy(logits, mb[1])), None
+                st_acc = jax.tree.map(jnp.add, st_acc, stats)
+                return (
+                    g_acc, loss_acc + loss, acc_acc + accuracy(logits, mb[1]), st_acc
+                ), None
 
-            (grads, loss, acc), _ = lax.scan(
-                body, (zero, jnp.zeros(()), jnp.zeros(())), (mb_x, mb_y)
+            (grads, loss, acc, stats), _ = lax.scan(
+                body, (zero, jnp.zeros(()), jnp.zeros(()), stats_zero), (mb_x, mb_y)
             )
             grads = jax.tree.map(lambda g: g / parts, grads)
+            stats = jax.tree.map(lambda s: s / parts, stats)
             loss, acc = loss / parts, acc / parts
         params, opt_state = optimizer.update(state.params, grads, state.opt_state)
+        params = merge_stat_updates(params, stats)
         return (
             TrainState(params, opt_state, state.step + 1),
             {"loss": loss, "accuracy": acc},
@@ -211,6 +257,7 @@ def make_spatial_train_step(
     from_probs: bool = False,
     spatial_until: Optional[int] = None,
     junction: str = "gather",
+    bn_stats: bool = True,
 ):
     """SP(+DP) training step: one shard_map over the whole step.
 
@@ -225,8 +272,9 @@ def make_spatial_train_step(
     ctx = ApplyCtx(train=True, spatial=sp, data_axis="data" if with_data_axis else None)
 
     def loss_fn(params_list, x, labels):
+        c = dataclasses.replace(ctx, bn_sink={}) if bn_stats else ctx
         logits = apply_spatial_model(
-            model, params_list, x, ctx, spatial_until=spatial_until, junction=junction
+            model, params_list, x, c, spatial_until=spatial_until, junction=junction
         )
         if isinstance(logits, tuple):
             logits = logits[0]
@@ -236,7 +284,8 @@ def make_spatial_train_step(
             labels = lax.dynamic_slice_in_dim(
                 labels, tile_linear_index(sp) * shard, shard, axis=0
             )
-        return cross_entropy(logits, labels, from_probs), (logits, labels)
+        stats = stat_updates_from_sink(c.bn_sink, params_list) if bn_stats else None
+        return cross_entropy(logits, labels, from_probs), (logits, labels, stats)
     grad_axes = tuple(a for a in (sp.axis_h, sp.axis_w) if a)
     if with_data_axis:
         grad_axes = ("data",) + grad_axes
@@ -254,13 +303,13 @@ def make_spatial_train_step(
 
     def sharded_step(params, opt_state, x, labels):
         def grads_for(p, xx, yy):
-            (loss, (logits, yy_used)), grads = jax.value_and_grad(
+            (loss, (logits, yy_used, stats)), grads = jax.value_and_grad(
                 global_loss_fn, has_aux=True
             )(p, xx.astype(compute_dtype), yy)
-            return loss, accuracy(logits, yy_used), grads
+            return loss, accuracy(logits, yy_used), stats, grads
 
         if parts == 1:
-            loss, acc, grads = grads_for(params, x, labels)
+            loss, acc, stats, grads = grads_for(params, x, labels)
         else:
             mb_x = x.reshape(parts, x.shape[0] // parts, *x.shape[1:])
             mb_y = labels.reshape(parts, labels.shape[0] // parts)
@@ -268,24 +317,33 @@ def make_spatial_train_step(
             # required for correct collective transposes under shard_map AD).
             v = lambda t: lax.pcast(t, grad_axes, to="varying")
             zero = jax.tree.map(lambda p: v(jnp.zeros_like(p)), params)
+            stats_struct = jax.eval_shape(grads_for, params, mb_x[0], mb_y[0])[2]
+            stats_zero = jax.tree.map(
+                lambda s: jnp.zeros(s.shape, s.dtype), stats_struct
+            )
 
             def body(carry, mb):
-                g_acc, l_acc, a_acc = carry
-                loss, acc, grads = grads_for(params, mb[0], mb[1])
+                g_acc, l_acc, a_acc, st_acc = carry
+                loss, acc, stats, grads = grads_for(params, mb[0], mb[1])
                 return (
                     jax.tree.map(jnp.add, g_acc, grads),
                     l_acc + loss,
                     a_acc + acc,
+                    jax.tree.map(jnp.add, st_acc, stats),
                 ), None
 
-            (grads, loss, acc), _ = lax.scan(
-                body, (zero, v(jnp.zeros(())), v(jnp.zeros(()))), (mb_x, mb_y)
+            (grads, loss, acc, stats), _ = lax.scan(
+                body,
+                (zero, v(jnp.zeros(())), v(jnp.zeros(())), stats_zero),
+                (mb_x, mb_y),
             )
             grads = jax.tree.map(lambda g: g / parts, grads)
+            stats = jax.tree.map(lambda s: s / parts, stats)
             loss, acc = loss / parts, acc / parts
 
         grads = jax.tree.map(lambda g: lax.pmean(g, grad_axes), grads)
         new_params, new_opt = optimizer.update(params, grads, opt_state)
+        new_params = merge_stat_updates(new_params, stats)
         metrics = {
             "loss": lax.pmean(loss, grad_axes),
             "accuracy": lax.pmean(acc, grad_axes),
@@ -307,3 +365,83 @@ def make_spatial_train_step(
         return TrainState(params, opt_state, state.step + 1), metrics
 
     return step
+
+
+# ---------------------------------------------------------------------------
+# Eval / inference steps (train=False: BN normalizes with running stats)
+# ---------------------------------------------------------------------------
+
+
+def make_eval_step(
+    model: CellModel,
+    mesh: Optional[Mesh] = None,
+    compute_dtype=jnp.float32,
+    from_probs: bool = False,
+):
+    """Inference step `(params_list, x, labels) -> metrics` (train=False, so
+    BN uses running statistics — the path the reference exercises implicitly
+    through nn.BatchNorm2d.eval(), which round 1 lacked entirely)."""
+    ctx = ApplyCtx(train=False)
+
+    def estep(params_list, x, labels):
+        logits = model.apply(params_list, x.astype(compute_dtype), ctx)
+        if isinstance(logits, tuple):
+            logits = logits[0]
+        return {
+            "loss": cross_entropy(logits, labels, from_probs),
+            "accuracy": accuracy(logits, labels),
+            "logits": logits,
+        }
+
+    if mesh is None:
+        return jax.jit(estep)
+    data_spec = NamedSharding(mesh, P("data"))
+    return jax.jit(estep, in_shardings=(None, data_spec, data_spec))
+
+
+def make_spatial_eval_step(
+    model: CellModel,
+    mesh: Mesh,
+    sp: SpatialCtx,
+    with_data_axis: bool = False,
+    compute_dtype=jnp.float32,
+    from_probs: bool = False,
+    spatial_until: Optional[int] = None,
+    junction: str = "gather",
+):
+    """SP(+DP) inference step: tiles in, metrics out (train=False)."""
+    from jax import shard_map
+
+    from mpi4dl_tpu.parallel.spatial import apply_spatial_model, tile_linear_index
+
+    ctx = ApplyCtx(
+        train=False, spatial=sp, data_axis="data" if with_data_axis else None
+    )
+    red_axes = tuple(a for a in (sp.axis_h, sp.axis_w) if a)
+    if with_data_axis:
+        red_axes = ("data",) + red_axes
+    x_spec = spatial_partition_spec(sp, data=with_data_axis)
+    y_spec = P("data") if with_data_axis else P()
+
+    def sharded_eval(params_list, x, labels):
+        logits = apply_spatial_model(
+            model, params_list, x.astype(compute_dtype), ctx,
+            spatial_until=spatial_until, junction=junction,
+        )
+        if isinstance(logits, tuple):
+            logits = logits[0]
+        if junction == "batch_split":
+            tiles = sp.grid_h * sp.grid_w
+            shard = labels.shape[0] // tiles
+            labels = lax.dynamic_slice_in_dim(
+                labels, tile_linear_index(sp) * shard, shard, axis=0
+            )
+        return {
+            "loss": lax.pmean(cross_entropy(logits, labels, from_probs), red_axes),
+            "accuracy": lax.pmean(accuracy(logits, labels), red_axes),
+        }
+
+    smapped = shard_map(
+        sharded_eval, mesh=mesh, in_specs=(P(), x_spec, y_spec), out_specs=P()
+    )
+    return jax.jit(smapped)

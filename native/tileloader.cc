@@ -1,0 +1,107 @@
+// Native tile loader for the image-folder data path (APP=1).
+//
+// The reference has no native code in-repo (its native layer is external
+// MVAPICH2-GDR + a patched ProcessGroupMPI, SURVEY §2 bottom rows); its data
+// loading rides torchvision/PIL on worker processes.  Here the hot host-side
+// work — decoding raw u8 images, normalizing to float32, center-crop/tiling
+// to the target resolution, and cutting per-device spatial tiles for SP input
+// splitting (the reference's split_input, train_spatial.py:241-290, done on
+// GPU there) — is a small C++ library driven from Python via ctypes
+// (mpi4dl_tpu/data_native.py).  For multi-thousand-pixel pathology/satellite
+// frames this is the difference between the input pipeline keeping up with
+// the TPU step or not.
+//
+// Build:  g++ -O3 -shared -fPIC -o libtileloader.so tileloader.cc
+// (data_native.py builds it on demand and caches the .so.)
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+extern "C" {
+
+// Read a raw interleaved-RGB u8 file and produce a float32 HWC image of
+// side `image_size`, values in [0, 1].  The stored side is inferred as
+// isqrt(bytes/3).  Larger images are center-cropped; smaller ones tiled.
+// Returns 0 on success, negative errno-style codes otherwise.
+int tl_load_rgb(const char* path, int image_size, float* out) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  std::fseek(f, 0, SEEK_END);
+  long bytes = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  if (bytes < 3) {
+    std::fclose(f);
+    return -2;
+  }
+  long side = (long)std::sqrt((double)(bytes / 3));
+  while ((side + 1) * (side + 1) * 3 <= bytes) side++;
+  while (side > 0 && side * side * 3 > bytes) side--;
+  if (side <= 0) {
+    std::fclose(f);
+    return -2;
+  }
+  long need = side * side * 3;
+  uint8_t* buf = new uint8_t[need];
+  size_t got = std::fread(buf, 1, (size_t)need, f);
+  std::fclose(f);
+  if ((long)got != need) {
+    delete[] buf;
+    return -3;
+  }
+  const float inv = 1.0f / 255.0f;
+  if (side >= image_size) {
+    long o = (side - image_size) / 2;  // center crop
+    for (int y = 0; y < image_size; y++) {
+      const uint8_t* row = buf + ((o + y) * side + o) * 3;
+      float* orow = out + (long)y * image_size * 3;
+      for (int i = 0; i < image_size * 3; i++) orow[i] = row[i] * inv;
+    }
+  } else {  // tile up to target
+    for (int y = 0; y < image_size; y++) {
+      const uint8_t* row = buf + (long)(y % side) * side * 3;
+      float* orow = out + (long)y * image_size * 3;
+      for (int x = 0; x < image_size; x++) {
+        const uint8_t* px = row + (long)(x % side) * 3;
+        orow[x * 3 + 0] = px[0] * inv;
+        orow[x * 3 + 1] = px[1] * inv;
+        orow[x * 3 + 2] = px[2] * inv;
+      }
+    }
+  }
+  delete[] buf;
+  return 0;
+}
+
+// Load a batch: `paths` is n C-strings; out is [n, image_size, image_size, 3]
+// contiguous float32.  Returns the index of the first failing file, or -1 if
+// all succeeded.
+int tl_load_batch(const char** paths, int n, int image_size, float* out) {
+  const long stride = (long)image_size * image_size * 3;
+  for (int i = 0; i < n; i++) {
+    if (tl_load_rgb(paths[i], image_size, out + (long)i * stride) != 0) return i;
+  }
+  return -1;
+}
+
+// Cut the (row, col) tile of a tile_h x tile_w grid out of a contiguous
+// float32 NHWC batch — the host-side form of the reference's split_input
+// slicing (train_spatial.py:241-290).  out is [n, th, tw, c].
+void tl_crop_tiles(const float* batch, int n, int h, int w, int c, int row,
+                   int col, int grid_h, int grid_w, float* out) {
+  const int th = h / grid_h, tw = w / grid_w;
+  const long img = (long)h * w * c, timg = (long)th * tw * c;
+  const int y0 = row * th, x0 = col * tw;
+  for (int i = 0; i < n; i++) {
+    const float* src = batch + i * img;
+    float* dst = out + i * timg;
+    for (int y = 0; y < th; y++) {
+      std::memcpy(dst + (long)y * tw * c,
+                  src + ((long)(y0 + y) * w + x0) * c,
+                  sizeof(float) * (size_t)tw * c);
+    }
+  }
+}
+
+}  // extern "C"

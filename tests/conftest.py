@@ -16,6 +16,16 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 jax.config.update("jax_threefry_partitionable", True)
 
+# Persistent compilation cache: the CPU-mesh programs here are compile-bound
+# (single-core box: full-suite wall-clock is dominated by XLA compiles), and
+# identical across runs — cache them on disk so iterating on tests is fast.
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.environ.get("MPI4DL_TPU_JAX_CACHE", "/tmp/mpi4dl_tpu_jax_cache"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -1,0 +1,75 @@
+"""Native C++ tile loader (native/tileloader.cc via ctypes): must agree with
+the pure-numpy path bit-for-bit and survive absence of a compiler."""
+
+import os
+
+import numpy as np
+import pytest
+
+from mpi4dl_tpu import data_native
+
+
+@pytest.fixture(scope="module")
+def lib_ok():
+    if not data_native.available():
+        pytest.skip("native tileloader unavailable (no g++)")
+    return True
+
+
+def _write_rgb(path, side, seed=0):
+    rng = np.random.default_rng(seed)
+    raw = rng.integers(0, 256, size=(side, side, 3), dtype=np.uint8)
+    raw.tofile(path)
+    return raw
+
+
+def test_load_rgb_center_crop(tmp_path, lib_ok):
+    p = str(tmp_path / "img.rgb")
+    raw = _write_rgb(p, 16)
+    out = data_native.load_rgb(p, 8)
+    assert out is not None and out.shape == (8, 8, 3)
+    want = raw[4:12, 4:12].astype(np.float32) / 255.0
+    np.testing.assert_allclose(out, want, atol=1e-6)
+
+
+def test_load_rgb_tile_up(tmp_path, lib_ok):
+    p = str(tmp_path / "img.rgb")
+    raw = _write_rgb(p, 4)
+    out = data_native.load_rgb(p, 8)
+    assert out is not None
+    want = np.tile(raw.astype(np.float32) / 255.0, (2, 2, 1))
+    np.testing.assert_allclose(out, want, atol=1e-6)
+
+
+def test_load_batch(tmp_path, lib_ok):
+    paths = []
+    for i in range(3):
+        p = str(tmp_path / f"im{i}.rgb")
+        _write_rgb(p, 8, seed=i)
+        paths.append(p)
+    out = data_native.load_batch(paths, 8)
+    assert out is not None and out.shape == (3, 8, 8, 3)
+    for i, p in enumerate(paths):
+        np.testing.assert_allclose(out[i], data_native.load_rgb(p, 8), atol=0)
+
+
+def test_crop_tiles_matches_numpy(lib_ok):
+    rng = np.random.default_rng(0)
+    batch = rng.standard_normal((2, 8, 12, 3)).astype(np.float32)
+    for row in range(2):
+        for col in range(3):
+            got = data_native.crop_tiles(batch, row, col, 2, 3)
+            want = batch[:, row * 4 : (row + 1) * 4, col * 4 : (col + 1) * 4]
+            np.testing.assert_array_equal(got, want)
+
+
+def test_image_folder_uses_native(tmp_path, lib_ok):
+    from mpi4dl_tpu.data import ImageFolderDataset
+
+    cdir = tmp_path / "class_a"
+    os.makedirs(cdir)
+    _write_rgb(str(cdir / "a.rgb"), 8)
+    ds = ImageFolderDataset(str(tmp_path), image_size=8)
+    x, y = ds.batch(0, 2)
+    assert x.shape == (2, 8, 8, 3) and y.shape == (2,)
+    assert x.dtype == np.float32

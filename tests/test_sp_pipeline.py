@@ -91,6 +91,43 @@ def test_sp_pipeline_batch_split_junction(devices8):
     assert losses[-1] < losses[0], losses
 
 
+def test_sp_pipeline_batch_split_exact_bn_free(devices8):
+    """ADVICE r1: pin the gradient-combine rule for the batch_split junction
+    too.  On a BN-free model the junction's batch re-sharding is numerically
+    transparent, so SP×PP with batch_split must reproduce single-device SGD
+    exactly — any mis-scaled collective transpose would show up here."""
+    from mpi4dl_tpu.cells import CellModel, LayerCell
+    from mpi4dl_tpu.layers import Conv2d, Dense, Flatten, ReLU
+
+    cells = [
+        LayerCell([Conv2d(3, 8, 3), ReLU()], name="c0"),
+        LayerCell([Conv2d(8, 8, 3, stride=2), ReLU()], name="c1"),
+        LayerCell([Conv2d(8, 8, 3), ReLU()], name="c2"),
+        LayerCell([Flatten(), Dense(8 * 16 * 16, 10)], name="head"),
+    ]
+    model = CellModel(cells, (4, 32, 32, 3), 10, spatial_until=2)
+    params, _ = model.init(jax.random.key(0))
+    sp = SpatialCtx(axis_h="sph", axis_w="spw", grid_h=2, grid_w=2)
+    mesh = build_mesh(MeshSpec(data=1, stage=2, sph=2, spw=2), jax.devices()[:8])
+
+    parts, mb = 2, 4  # batch 8; each stage chunk of 4 splits over 4 tiles
+    spp, opt, step, state = _mk(model, params, mesh, sp, 2, parts, mb, "batch_split")
+    ref_step = make_train_step(model, opt, parts=parts)
+    ref_state = TrainState.create(params, opt)
+
+    x = jax.random.normal(jax.random.key(5), (8, 32, 32, 3))
+    y = jnp.arange(8, dtype=jnp.int32) % 10
+    for _ in range(2):
+        ref_state, m_ref = ref_step(ref_state, x, y)
+        state, m = step(state, x, y)
+        np.testing.assert_allclose(float(m_ref["loss"]), float(m["loss"]), rtol=1e-4)
+
+    got = spp.unpack_all(np.asarray(state.sp_buf), np.asarray(state.tail_buf))
+    want = jax.tree.leaves(ref_state.params)
+    for a, b in zip(jax.tree.leaves(got), want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=1e-5)
+
+
 def test_sp_pipeline_amoebanet_tuple_junction(devices8):
     """AmoebaNet's (x, skip) tuple state must cross the SP→LP junction and
     the stage handoffs (reference MULTIPLE_INPUT support)."""
