@@ -5,16 +5,28 @@ reference's flagship 1024x1024 resolution, batch size 1 — the configuration of
 the reference's published charts (BASELINE.md: best bs1 result at 1024² is
 ≈2.1 img/s for SP square + halo-D2 across FIVE GPUs, i.e. ≈0.42 img/s/GPU).
 
-``vs_baseline`` is our single-chip img/s divided by the 2.1 img/s cluster bar
-(the headline comparison, chip-count mismatch stated in the metric name);
-``vs_baseline_per_device`` divides by 2.1/5.  Both are null when the run had
-to fall back to an incomparable configuration (CPU smoke / reduced size).
+Honesty instrumentation (round 3): the step's FLOPs are taken from XLA's own
+``compiled.cost_analysis()`` and the JSON carries ``flops_per_step``,
+``achieved_tflops`` and ``mfu`` against the chip's bf16 peak.  A measurement
+with mfu > 1 is *physically impossible* and is treated as a failed
+measurement: the run falls back to per-step ``jax.block_until_ready`` on the
+FULL state (which cannot overcount — every step's outputs are materialized
+between timestamps) with more iterations and fresh inputs each step.  If even
+the blocked measurement lands above peak, ``vs_baseline`` is null and an
+``error`` explains.
 
-Robustness: the measurement runs in a SUBPROCESS so a broken TPU plugin (the
-round-1 failure: axon init raised at jax.devices()) cannot kill the benchmark
-before it prints.  Ladder: TPU@1024² → TPU@512² → CPU smoke.  The outer
-process re-prints the first inner JSON line that parses; if every rung fails
-it still prints a JSON line with value 0 and the failure tail.
+Memory-capability rungs (round 3): in addition to the 1024² headline, the
+JSON carries a 2048² bs1 measurement (the reference's OOM frontier — ResNet
+2048² bs2 OOMs on its GPUs, BASELINE.md) under ``rungs``, and
+``max_trainable_px`` — the largest square resolution that completes a bs1
+training step on one chip with remat+bf16, found by doubling + one midpoint
+refinement (each attempt in a subprocess so OOM cannot kill the benchmark).
+
+Robustness: every measurement runs in a SUBPROCESS so a broken TPU plugin
+(the round-1 failure: axon init raised at jax.devices()) cannot kill the
+benchmark before it prints.  Ladder: TPU@1024² → TPU@512² → CPU smoke.  The
+outer process re-prints the first inner JSON line that parses; if every rung
+fails it still prints a JSON line with value 0 and the failure tail.
 """
 
 from __future__ import annotations
@@ -27,34 +39,50 @@ import time
 
 BASELINE_CLUSTER = 2.1   # reference: AmoebaNet-D 1024² bs1, SP square + D2, 5 GPUs
 BASELINE_DEVICES = 5
+BASELINE_2048 = 2.85     # reference: AmoebaNet-D 2048² bs1, SP vertical + D2, 5 GPUs
+
+# bf16 peak FLOP/s by TPU generation (public numbers); matched by substring of
+# jax.devices()[0].device_kind.  Used only for the mfu sanity check.
+_PEAKS = [
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5 lite", 197e12), ("v5e", 197e12), ("v5litepod", 197e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 46e12),
+]
 
 # (name, platform, image_size, num_layers, num_filters, warmup, iters, timeout_s, comparable)
 LADDER = [
-    ("tpu_1024", "tpu", 1024, 18, 416, 2, 8, 1500, True),
+    ("tpu_1024", "tpu", 1024, 18, 416, 2, 8, 1800, True),
     ("tpu_512", "tpu", 512, 18, 416, 2, 8, 900, False),
     ("cpu_smoke", "cpu", 128, 3, 64, 1, 3, 600, False),
 ]
 
+PROBE_TIMEOUT_S = 1500
 
-def _inner(platform: str, image_size: int, num_layers: int, num_filters: int,
-           warmup: int, iters: int, comparable: bool) -> None:
+
+def _peak_flops(device) -> float | None:
+    kind = getattr(device, "device_kind", "") or ""
+    k = kind.lower()
+    if device.platform == "cpu":
+        return None  # no defensible peak for the host CPU; skip mfu
+    for sub, peak in _PEAKS:
+        if sub in k:
+            return peak
+    # Unknown kind: assume the FASTEST known peak.  The mfu>1 check declares a
+    # measurement impossible, so the fallback must over- not under-estimate
+    # the chip (a low assumed peak would fail valid runs on faster chips).
+    return max(p for _, p in _PEAKS)
+
+
+def _build_step(image_size: int, num_layers: int, num_filters: int, batch: int = 1):
     import jax
     import jax.numpy as jnp
 
     from mpi4dl_tpu.models.amoebanet import amoebanetd
     from mpi4dl_tpu.train import Optimizer, TrainState, make_train_step
-
-    dev = jax.devices()[0]
-    print(f"[bench] platform={dev.platform} device={dev}", file=sys.stderr)
-    # The axon TPU plugin may report its platform as 'tpu' or 'axon'; the only
-    # disqualifying case is a TPU rung landing on the CPU fallback (it would
-    # grind the huge config on the host) and vice versa.
-    is_cpu = dev.platform == "cpu"
-    if (platform == "tpu") == is_cpu:
-        print(f"[bench] wanted {platform!r}, got {dev.platform!r} — bail",
-              file=sys.stderr)
-        sys.exit(3)
-    batch = 1
 
     model = amoebanetd(
         (batch, image_size, image_size, 3),
@@ -68,57 +96,182 @@ def _inner(platform: str, image_size: int, num_layers: int, num_filters: int,
     # 1024² bs1 on one chip (the reference needs 5 GPUs for this workload).
     step = make_train_step(model, opt, compute_dtype=jnp.bfloat16, remat=True)
     state = TrainState.create(params, opt)
+    return step, state
 
-    x = jax.random.normal(jax.random.key(1), (batch, image_size, image_size, 3))
-    y = jnp.zeros((batch,), jnp.int32)
+
+def _step_flops(step, state, x, y) -> float | None:
+    """FLOPs of one compiled training step from XLA's own cost model."""
+    try:
+        ca = step.lower(state, x, y).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        f = float(ca.get("flops", 0.0))
+        return f if f > 0 else None
+    except Exception as e:  # noqa: BLE001 — any backend may lack cost_analysis
+        print(f"[bench] cost_analysis unavailable: {e}", file=sys.stderr)
+        return None
+
+
+def _measure(step, state, xs, ys, iters: int, blocked: bool):
+    """Time `iters` steps cycling through fresh inputs.
+
+    blocked=False: steps chain through state; one block_until_ready on the
+    full final (state, metrics) plus a device-to-host fetch of the final loss
+    — standard async JAX timing.
+    blocked=True: fetch the loss scalar to the HOST every step.  A D2H copy
+    cannot complete before the value exists, so this is immune to any
+    dispatch/readiness artifact of the experimental axon RPC backend (whose
+    block_until_ready has been observed returning early — the round-2
+    275 img/s fiction); it is a strict upper bound on step time.
+    """
+    import jax
+
+    n = len(xs)
+    t0 = time.perf_counter()
+    metrics = None
+    for i in range(iters):
+        state, metrics = step(state, xs[i % n], ys[i % n])
+        if blocked:
+            float(metrics["loss"])
+    float(metrics["loss"])
+    jax.block_until_ready(state)
+    return time.perf_counter() - t0, state
+
+
+def _inner(platform: str, image_size: int, num_layers: int, num_filters: int,
+           warmup: int, iters: int, comparable: bool) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    print(f"[bench] platform={dev.platform} device={dev} "
+          f"kind={getattr(dev, 'device_kind', '?')}", file=sys.stderr)
+    # The axon TPU plugin may report its platform as 'tpu' or 'axon'; the only
+    # disqualifying case is a TPU rung landing on the CPU fallback (it would
+    # grind the huge config on the host) and vice versa.
+    is_cpu = dev.platform == "cpu"
+    if (platform == "tpu") == is_cpu:
+        print(f"[bench] wanted {platform!r}, got {dev.platform!r} — bail",
+              file=sys.stderr)
+        sys.exit(3)
+    batch = 1
+
+    step, state = _build_step(image_size, num_layers, num_filters, batch)
+
+    # Fresh inputs: a small pool of distinct images cycled through the loop so
+    # no iteration can be satisfied by a cached/constant-folded result.
+    n_inputs = min(4, max(2, iters))
+    xs = [
+        jax.random.normal(jax.random.key(100 + i),
+                          (batch, image_size, image_size, 3))
+        for i in range(n_inputs)
+    ]
+    ys = [jnp.full((batch,), i % 1000, jnp.int32) for i in range(n_inputs)]
+
+    flops = _step_flops(step, state, xs[0], ys[0])
+    peak = _peak_flops(dev)
 
     t_c = time.perf_counter()
-    for _ in range(warmup):
-        state, metrics = step(state, x, y)
-    jax.block_until_ready(metrics["loss"])
-    print(f"[bench] compile+warmup {time.perf_counter() - t_c:.1f}s", file=sys.stderr)
+    for i in range(warmup):
+        state, metrics = step(state, xs[i % n_inputs], ys[i % n_inputs])
+    float(metrics["loss"])  # D2H: warmup really finished (see _measure)
+    jax.block_until_ready(state)
+    print(f"[bench] compile+warmup {time.perf_counter() - t_c:.1f}s; "
+          f"flops/step={flops}", file=sys.stderr)
 
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        state, metrics = step(state, x, y)
-    jax.block_until_ready(metrics["loss"])
-    dt = time.perf_counter() - t0
+    def mfu_of(dt: float, n_iters: int):
+        if flops is None or peak is None:
+            return None
+        return (flops * n_iters / dt) / peak
+
+    mode = "async_chain"
+    dt, state = _measure(step, state, xs, ys, iters, blocked=False)
+    mfu = mfu_of(dt, iters)
+    error = None
+    if mfu is not None and mfu > 1.0:
+        # Physically impossible — the async timing did not capture the real
+        # work.  Re-measure with per-step blocking on the full state and more
+        # iterations; this cannot overcount.
+        print(f"[bench] mfu={mfu:.2f} > 1 under async timing — "
+              f"falling back to per-step blocking", file=sys.stderr)
+        mode = "per_step_blocked"
+        iters = iters * 2
+        dt, state = _measure(step, state, xs, ys, iters, blocked=True)
+        mfu = mfu_of(dt, iters)
+        if mfu is not None and mfu > 1.0:
+            error = (f"measurement failed: mfu={mfu:.2f} > 1 even with "
+                     f"per-step block_until_ready on the full state")
 
     img_per_sec = batch * iters / dt
+    achieved = (flops * iters / dt) if flops else None
+    ok = error is None
     out = {
         "metric": f"amoebanetd_{image_size}px_bs{batch}_train_img_per_sec"
                   "_single_chip_vs_5gpu_cluster_baseline",
         "value": round(img_per_sec, 4),
         "unit": "images/sec",
-        "vs_baseline": round(img_per_sec / BASELINE_CLUSTER, 4) if comparable else None,
+        "vs_baseline": (
+            round(img_per_sec / BASELINE_CLUSTER, 4) if (comparable and ok) else None
+        ),
         "vs_baseline_per_device": (
             round(img_per_sec / (BASELINE_CLUSTER / BASELINE_DEVICES), 4)
-            if comparable else None
+            if (comparable and ok) else None
         ),
         "baseline_img_per_sec_cluster": BASELINE_CLUSTER,
         "baseline_devices": BASELINE_DEVICES,
-        "platform": jax.devices()[0].platform,
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", None),
+        "timing_mode": mode,
+        "iters": iters,
+        "flops_per_step": flops,
+        "achieved_tflops": round(achieved / 1e12, 2) if achieved else None,
+        "peak_tflops": round(peak / 1e12, 1) if peak else None,
+        "mfu": round(mfu, 4) if mfu is not None else None,
     }
+    if error:
+        out["error"] = error
     print(json.dumps(out))
 
 
-def _try_rung(name, platform, image_size, num_layers, num_filters,
-              warmup, iters, timeout_s, comparable):
+def _inner_probe(image_size: int) -> None:
+    """Train ONE bs1 step at image_size; print a tiny JSON on success.
+
+    OOM aborts the process — the outer driver interprets death as 'does not
+    fit'.  Exits 3 if not actually on an accelerator.
+    """
+    import jax
+
+    dev = jax.devices()[0]
+    if dev.platform == "cpu" and os.environ.get("BENCH_PROBE_CPU_OK") != "1":
+        sys.exit(3)
+    step, state = _build_step(image_size, 18, 416, 1)
+    import jax.numpy as jnp
+
+    x = jax.random.normal(jax.random.key(1), (1, image_size, image_size, 3))
+    y = jnp.zeros((1,), jnp.int32)
+    t0 = time.perf_counter()
+    state, metrics = step(state, x, y)
+    jax.block_until_ready((state, metrics))
+    dt = time.perf_counter() - t0
+    loss = float(metrics["loss"])
+    print(json.dumps({"ok": bool(loss == loss), "image_size": image_size,
+                      "first_step_s": round(dt, 1)}))
+
+
+def _run_sub(argv_tail, timeout_s, platform="tpu"):
     env = dict(os.environ)
     if platform == "cpu":
         env.pop("PALLAS_AXON_POOL_IPS", None)
         env["JAX_PLATFORMS"] = "cpu"
-    argv = [sys.executable, os.path.abspath(__file__), "--inner",
-            platform, str(image_size), str(num_layers), str(num_filters),
-            str(warmup), str(iters), "1" if comparable else "0"]
+    argv = [sys.executable, os.path.abspath(__file__)] + argv_tail
     try:
         proc = subprocess.run(
             argv, env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
             capture_output=True, text=True, timeout=timeout_s,
         )
     except subprocess.TimeoutExpired as e:
-        return None, f"{name}: timeout after {timeout_s}s; stderr tail: " \
-                     f"{(e.stderr or '')[-300:] if isinstance(e.stderr, str) else ''}"
+        tail = (e.stderr or "")[-300:] if isinstance(e.stderr, str) else ""
+        return None, f"timeout after {timeout_s}s; stderr tail: {tail}"
     sys.stderr.write(proc.stderr[-2000:] if proc.stderr else "")
     for line in reversed(proc.stdout.splitlines()):
         line = line.strip()
@@ -127,7 +280,51 @@ def _try_rung(name, platform, image_size, num_layers, num_filters,
                 return json.loads(line), None
             except json.JSONDecodeError:
                 continue
-    return None, f"{name}: rc={proc.returncode}; stderr tail: {(proc.stderr or '')[-300:]}"
+    return None, f"rc={proc.returncode}; stderr tail: {(proc.stderr or '')[-300:]}"
+
+
+def _try_rung(name, platform, image_size, num_layers, num_filters,
+              warmup, iters, timeout_s, comparable):
+    tail = ["--inner", platform, str(image_size), str(num_layers),
+            str(num_filters), str(warmup), str(iters),
+            "1" if comparable else "0"]
+    result, err = _run_sub(tail, timeout_s, platform)
+    if err:
+        err = f"{name}: {err}"
+    return result, err
+
+
+def _max_trainable_px(start: int = 2048, cap: int = 16384) -> tuple[int, dict]:
+    """Largest square resolution whose bs1 step completes on the chip.
+
+    Doubling ladder from `start`, then one midpoint refinement between the
+    last success and first failure.  Every attempt is a subprocess; any
+    death (OOM, crash, timeout) counts as 'does not fit'.
+    """
+    attempts = {}
+
+    def fits(px: int) -> bool:
+        result, err = _run_sub(["--probe", str(px)], PROBE_TIMEOUT_S)
+        ok = bool(result and result.get("ok"))
+        attempts[str(px)] = (
+            {"ok": True, "first_step_s": result.get("first_step_s")} if ok
+            else {"ok": False, "error": (err or "no output")[-120:]}
+        )
+        print(f"[bench] probe {px}px: {'fits' if ok else 'FAILS'}", file=sys.stderr)
+        return ok
+
+    best, px = 0, start
+    while px <= cap:
+        if not fits(px):
+            break
+        best, px = px, px * 2
+    if best and best < cap:
+        # midpoint of [best, min(2*best, cap)], /64-aligned, within the cap
+        mid = min((best * 3) // 2, cap)
+        mid -= mid % 64
+        if mid > best and fits(mid):
+            best = mid
+    return best, attempts
 
 
 def main() -> int:
@@ -136,24 +333,58 @@ def main() -> int:
         _inner(platform, int(image_size), int(num_layers), int(num_filters),
                int(warmup), int(iters), comp == "1")
         return 0
+    if len(sys.argv) > 1 and sys.argv[1] == "--probe":
+        _inner_probe(int(sys.argv[2]))
+        return 0
 
     failures = []
+    headline = None
     for rung in LADDER:
         print(f"[bench] trying rung {rung[0]}", file=sys.stderr)
         result, err = _try_rung(*rung)
         if result is not None:
-            print(json.dumps(result))
-            return 0
+            headline = result
+            headline["rung"] = rung[0]
+            break
         failures.append(err)
         print(f"[bench] rung failed: {err}", file=sys.stderr)
 
-    print(json.dumps({
-        "metric": "amoebanetd_train_img_per_sec_single_chip",
-        "value": 0,
-        "unit": "images/sec",
-        "vs_baseline": None,
-        "error": "; ".join(f for f in failures if f)[-500:],
-    }))
+    if headline is None:
+        print(json.dumps({
+            "metric": "amoebanetd_train_img_per_sec_single_chip",
+            "value": 0,
+            "unit": "images/sec",
+            "vs_baseline": None,
+            "error": "; ".join(f for f in failures if f)[-500:],
+        }))
+        return 0
+
+    on_tpu = headline.get("platform") != "cpu"
+    skip_extra = os.environ.get("BENCH_SKIP_MEMORY_RUNGS") == "1"
+    if on_tpu and not skip_extra:
+        # Memory-capability rung: the reference's OOM frontier (2048², bs1 —
+        # its GPUs OOM at bs2 across all schemes, BASELINE.md).
+        print("[bench] 2048px memory rung", file=sys.stderr)
+        r2048, err = _try_rung("tpu_2048", "tpu", 2048, 18, 416, 1, 4, 1800, False)
+        if r2048 is not None:
+            headline["rungs"] = {"2048": {
+                "img_per_sec": r2048["value"],
+                "mfu": r2048.get("mfu"),
+                "timing_mode": r2048.get("timing_mode"),
+                "vs_baseline_cluster_2048": (
+                    round(r2048["value"] / BASELINE_2048, 4)
+                    if not r2048.get("error") else None
+                ),
+            }}
+        else:
+            headline["rungs"] = {"2048": {"error": (err or "")[-200:]}}
+        # Max trainable resolution per chip (driver north-star metric).
+        print("[bench] max-resolution probe", file=sys.stderr)
+        best, attempts = _max_trainable_px()
+        headline["max_trainable_px"] = best
+        headline["max_trainable_px_attempts"] = attempts
+
+    print(json.dumps(headline))
     return 0
 
 

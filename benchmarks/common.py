@@ -41,29 +41,53 @@ from mpi4dl_tpu.config import ParallelConfig, config_from_args, get_parser
 from mpi4dl_tpu.utils import StepMeter, Timer
 
 
-def _spatial_ctx(cfg: ParallelConfig):
-    from mpi4dl_tpu.layer_ctx import spatial_ctx_for
+def _spatial_levels(cfg: ParallelConfig, n_cells: int):
+    """[(stop_cell, SpatialCtx)] for the spatial region.
 
-    return spatial_ctx_for(
+    Level i covers the cells of pipeline split i (reference: the first
+    `spatial_size` splits run conv_spatial, resnet_spatial.py:272-296) with
+    `num_spatial_parts[i]` tiles (multi-level SP, train_spatial.py:453-504);
+    a short parts list repeats its last element, and consecutive levels with
+    identical grids merge (no respatial between them)."""
+    from mpi4dl_tpu.cells import split_even
+    from mpi4dl_tpu.layer_ctx import spatial_levels_for
+
+    ranges = split_even(n_cells, max(cfg.split_size, 1), cfg.balance)
+    k = min(max(cfg.spatial_size, 1), len(ranges))
+    if cfg.split_size > 1 and k >= cfg.split_size:
+        # The SPxPP engine needs a non-spatial pipeline tail (the reference's
+        # models likewise keep non-spatial layers past end_layer — the head
+        # cannot run tiled).  Clamp and say so.
+        k = cfg.split_size - 1
+        print(
+            f"note: spatial_size clamped to {k} (split_size {cfg.split_size} "
+            "needs at least one non-spatial tail split)"
+        )
+    parts = list(cfg.num_spatial_parts)
+    if len(parts) > k:
+        print(
+            f"note: num_spatial_parts {parts} has more levels than the "
+            f"{k} spatial split(s); using {parts[:k]} (raise --spatial-size "
+            "and --split-size to use the full chain)"
+        )
+    parts = (parts + [parts[-1]] * k)[:k]
+    ctxs = spatial_levels_for(
         cfg.slice_method,
-        cfg.spatial_part_size,
+        parts,
         bn_cross_tile=cfg.bn_cross_tile,
         d2_mode=cfg.halo_d2,
         # --fused-layers caps margin-consuming layers per fused exchange
         # (reference resnet_spatial_d2.py get_balance); <=0 → maximal fusion.
         d2_max_fused=cfg.fused_layers if cfg.fused_layers > 0 else None,
     )
-
-
-def _spatial_until(cfg: ParallelConfig, n_cells: int) -> int:
-    """Number of leading cells in the spatial region: the cells of the first
-    `spatial_size` pipeline splits (reference: the first spatial_size splits
-    run conv_spatial, resnet_spatial.py:272-296)."""
-    from mpi4dl_tpu.cells import split_even
-
-    ranges = split_even(n_cells, max(cfg.split_size, 1), cfg.balance)
-    take = min(max(cfg.spatial_size, 1), len(ranges))
-    return ranges[take - 1][1]
+    levels = []
+    for i in range(k):
+        stop = ranges[i][1]
+        if levels and ctxs[i] == levels[-1][1]:
+            levels[-1] = (stop, ctxs[i])
+        else:
+            levels.append((stop, ctxs[i]))
+    return levels
 
 
 def build_train(cfg: ParallelConfig, family: str, mesh):
@@ -144,9 +168,11 @@ def build_train(cfg: ParallelConfig, family: str, mesh):
         )
 
     # Spatial families
-    sp = _spatial_ctx(cfg)
-    model.spatial_until = _spatial_until(cfg, len(model.cells))
+    levels = _spatial_levels(cfg, len(model.cells))
+    sp = levels[0][1]
+    model.spatial_until = levels[-1][0]
     junction = "batch_split" if cfg.local_dp_lp > 1 else "gather"
+    local_dp = cfg.local_dp_lp if cfg.local_dp_lp > 1 else None
 
     if family == "sp" and cfg.split_size <= 1:
         from mpi4dl_tpu.train import make_spatial_train_step
@@ -155,6 +181,7 @@ def build_train(cfg: ParallelConfig, family: str, mesh):
             model, opt, mesh, sp, parts=cfg.parts, with_data_axis=dp > 1,
             compute_dtype=dtype, from_probs=from_probs,
             spatial_until=model.spatial_until, junction=junction,
+            levels=levels, local_dp=local_dp,
         )
         state = TrainState.create(params, opt)
         return step, state, (lambda s: s.params), cfg.batch_size * dp
@@ -172,6 +199,7 @@ def build_train(cfg: ParallelConfig, family: str, mesh):
     spp = SPPipeline.build(
         model, params, max(cfg.split_size, 2), sp, microbatch=micro,
         junction=junction, balance=cfg.balance, compute_dtype=dtype,
+        levels=levels, local_dp=local_dp,
     )
     if family == "gems_sp":
         step = make_sp_gems_train_step(

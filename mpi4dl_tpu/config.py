@@ -77,6 +77,21 @@ class ParallelConfig:
         if self.spatial_size > 0 and self.spatial_part_size > 1:
             assert is_power_two(self.image_size), "image_size must be a power of two"
             assert self.image_size % self.spatial_part_size == 0
+            # Multi-level SP (reference num_spatial_parts="4,2"): later levels
+            # must not grow and must embed in the level-0 grid (checked by
+            # spatial_levels_for); LOCAL_DP_LP shards over the tile devices.
+            for p in self.num_spatial_parts[1:]:
+                assert p <= self.spatial_part_size, (
+                    f"spatial levels must not grow: {self.num_spatial_parts}"
+                )
+                assert self.spatial_part_size % p == 0, (
+                    f"level tile count {p} must divide {self.spatial_part_size}"
+                )
+            if self.local_dp_lp > 1:
+                assert self.spatial_part_size % self.local_dp_lp == 0, (
+                    f"--local-DP {self.local_dp_lp} must divide the "
+                    f"{self.spatial_part_size} spatial-tile devices"
+                )
         assert self.batch_size % self.parts == 0, "batch must divide into parts"
         if self.balance is not None:
             assert len(self.balance) == self.split_size

@@ -35,6 +35,15 @@ class SpatialCtx:
     axis_w: Optional[str] = None
     grid_h: int = 1
     grid_w: int = 1
+    # Replication factor per axis: the mesh axis has grid*rep devices and each
+    # tile is held by `rep` consecutive devices (tile index = axis_index//rep).
+    # rep > 1 arises at the COARSER levels of multi-level spatial parallelism
+    # (reference num_spatial_parts="4,2", train_spatial.py:453-504): the level
+    # runs on fewer tiles than the mesh axis carries, and the freed devices
+    # either duplicate tile compute or take batch shards at the junction.
+    # Halo exchange with rep>1 ppermutes with stride `rep` (ops/halo.py).
+    rep_h: int = 1
+    rep_w: int = 1
     # BatchNorm statistics scope: True → psum batch stats across the tile grid
     # (numerically equals single-device training); False → per-tile stats, the
     # reference's behaviour (plain nn.BatchNorm2d inside spatial layers,
@@ -124,3 +133,54 @@ def spatial_ctx_for(slice_method: str, num_spatial_parts: int, **kw) -> SpatialC
             )
         return SpatialCtx(axis_h="sph", axis_w="spw", grid_h=g, grid_w=g, **kw)
     raise ValueError(f"unknown slice_method {slice_method!r}")
+
+
+def _level_grid(parts: int, gh0: int, gw0: int) -> tuple:
+    """Factor `parts` into a (gh, gw) sub-grid of the base (gh0, gw0) grid —
+    gh | gh0 and gw | gw0 — preferring the most square factorization (ties go
+    to the wider-W split: spw is the innermost, most bandwidth-local axis)."""
+    best = None
+    for d in range(1, parts + 1):
+        if parts % d:
+            continue
+        e = parts // d
+        if gh0 % d == 0 and gw0 % e == 0:
+            score = abs(d - e)
+            if best is None or score < best[0]:
+                best = (score, d, e)
+    if best is None:
+        raise ValueError(
+            f"spatial level of {parts} tiles does not embed in the base "
+            f"{gh0}x{gw0} grid: need a factorization gh*gw={parts} with "
+            f"gh | {gh0} and gw | {gw0}"
+        )
+    return best[1], best[2]
+
+
+def spatial_levels_for(slice_method: str, parts_list, **kw) -> list:
+    """Per-level SpatialCtx chain for multi-level spatial parallelism
+    (reference ``num_spatial_parts="4,2"``: successive spatial pipeline splits
+    run on shrinking tile grids, train_spatial.py:453-504, :557-641).
+
+    Level 0 defines the mesh axes (rep=1).  Later levels keep the SAME axes
+    but a coarser grid with replication factor rep = base_grid/level_grid;
+    transitions between levels are a :func:`parallel.spatial.respatial`
+    re-shard (one all_gather + slice, the TPU form of the reference's skewed
+    spatial→spatial send/recv).
+    """
+    parts_list = list(parts_list)
+    base = spatial_ctx_for(slice_method, parts_list[0], **kw)
+    out = [base]
+    gh0, gw0 = base.grid_h, base.grid_w
+    for p in parts_list[1:]:
+        if p > parts_list[0]:
+            raise ValueError(
+                f"spatial levels must not grow: {p} > {parts_list[0]}"
+            )
+        gh, gw = _level_grid(p, gh0, gw0)
+        out.append(
+            dataclasses.replace(
+                base, grid_h=gh, grid_w=gw, rep_h=gh0 // gh, rep_w=gw0 // gw
+            )
+        )
+    return out

@@ -124,7 +124,8 @@ class Conv2d(Layer):
             # runs VALID on the sharded dims, consuming ph/pw of margin.
             if not sp.halo_pre_exchanged and (halo_h.lo or halo_w.lo):
                 x = halo_exchange_2d(
-                    x, halo_h, halo_w, sp.axis_h, sp.axis_w, sp.grid_h, sp.grid_w
+                    x, halo_h, halo_w, sp.axis_h, sp.axis_w, sp.grid_h, sp.grid_w,
+                    rep_h=sp.rep_h, rep_w=sp.rep_w,
                 )
             # A dim whose margin came from exchange (or pre-exchange) needs no
             # padding; unsharded dims keep explicit symmetric padding.
@@ -417,7 +418,8 @@ class Pool2d(Layer):
             halo_w = HaloSpec.symmetric(pw if sharded_w else 0)
             mask = jnp.ones(x.shape[:-1] + (1,), x.dtype)
             x, mask = halo_exchange_with_mask(
-                x, mask, halo_h, halo_w, sp.axis_h, sp.axis_w, sp.grid_h, sp.grid_w
+                x, mask, halo_h, halo_w, sp.axis_h, sp.axis_w, sp.grid_h, sp.grid_w,
+                rep_h=sp.rep_h, rep_w=sp.rep_w,
             )
             # Remaining explicit pad for unsharded dims
             rem_ph = 0 if sharded_h else ph

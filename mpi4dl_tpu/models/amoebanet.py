@@ -343,6 +343,7 @@ class AmoebaCell(Cell):
             t = halo_exchange_2d(
                 t, HaloSpec.symmetric(mh), HaloSpec.symmetric(mw),
                 sp.axis_h, sp.axis_w, sp.grid_h, sp.grid_w,
+                rep_h=sp.rep_h, rep_w=sp.rep_w,
             )
             states.append((t, mh, mw))
 

@@ -141,6 +141,8 @@ def run_layers_d2(layers: Sequence, params_seq, x, ctx: ApplyCtx):
         sp.axis_w,
         sp.grid_h,
         sp.grid_w,
+        rep_h=sp.rep_h,
+        rep_w=sp.rep_w,
     )
     y, mh_out, mw_out = apply_layers_premargin(layers, params_seq, x, ctx, mh, mw)
     assert mh_out == 0 and mw_out == 0, (mh_out, mw_out)

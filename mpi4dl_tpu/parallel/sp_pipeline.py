@@ -66,9 +66,10 @@ from mpi4dl_tpu.parallel.partition import (
     stat_leaf_info,
 )
 from mpi4dl_tpu.parallel.spatial import (
+    apply_spatial_region,
     gather_spatial,
+    junction_shard_index,
     scatter_batch_over_tiles,
-    tile_linear_index,
 )
 from mpi4dl_tpu.parallel.stage_common import (
     gems_dual_scan,
@@ -95,6 +96,12 @@ class SPPipeline:
     # positions in sp_buf for the write-back.
     sp_stat_leaf_ids: list = dataclasses.field(default_factory=list)
     sp_stat_idx: Optional[np.ndarray] = None
+    # Multi-level spatial region: [(stop_cell, SpatialCtx)] — level 0 is `sp`;
+    # None means the single level [(spatial_until, sp)].
+    levels: Optional[list] = None
+    # Junction batch-split degree (LOCAL_DP_LP, reference comm.py:278-294);
+    # defaults to the final level's tile count.
+    degree: int = 1
 
     @classmethod
     def build(
@@ -107,10 +114,18 @@ class SPPipeline:
         junction: str = "batch_split",
         balance=None,
         compute_dtype=jnp.float32,
+        levels: Optional[list] = None,
+        local_dp: Optional[int] = None,
     ) -> "SPPipeline":
         su = model.spatial_until
         assert 0 < su < len(model.cells), f"spatial_until={su} must split the model"
-        tiles = sp.grid_h * sp.grid_w
+        if levels is not None:
+            assert levels[-1][0] == su, (levels, su)
+            assert levels[0][1].rep_h == 1 and levels[0][1].rep_w == 1, (
+                "level 0 must be the mesh-defining (rep=1) ctx"
+            )
+        sp_last = levels[-1][1] if levels else sp
+        degree = local_dp if local_dp else sp_last.grid_h * sp_last.grid_w
         # Junction activation structure from abstract evaluation at GLOBAL
         # shapes (the reference's get_shapes_spatial tile math collapses into
         # eval_shape + one divide, train_spatial.py:61-238).
@@ -121,8 +136,8 @@ class SPPipeline:
             jax.ShapeDtypeStruct((microbatch, *model.in_shape[1:]), compute_dtype),
         )
         if junction == "batch_split":
-            assert microbatch % tiles == 0, (microbatch, tiles)
-            mb_tail = microbatch // tiles
+            assert microbatch % degree == 0, (microbatch, degree)
+            mb_tail = microbatch // degree
         else:
             mb_tail = microbatch
         tail_in = jax.tree.map(
@@ -149,7 +164,8 @@ class SPPipeline:
             else None
         )
         return cls(
-            model, su, sp, sp_pack, tail_part, junction, mb_tail, sp_ids, sp_idx
+            model, su, sp, sp_pack, tail_part, junction, mb_tail, sp_ids, sp_idx,
+            levels=levels, degree=degree,
         )
 
     def pack_spatial(self, params_list) -> jax.Array:
@@ -222,7 +238,9 @@ def _make_sp_step(
     part = spp.tail_part
     S = part.num_stages
     su = spp.spatial_until
-    tiles = sp.grid_h * sp.grid_w
+    levels = spp.levels if spp.levels is not None else [(su, sp)]
+    sp_last = levels[-1][1]
+    degree = spp.degree
     groups = 1
     for d in lead_shape:
         groups *= d
@@ -245,10 +263,10 @@ def _make_sp_step(
         assert B % S == 0, f"batch {B} must divide over {S} stage blocks"
         chunk = B // S
         if spp.junction == "batch_split":
-            assert chunk % tiles == 0, (
+            assert chunk % degree == 0, (
                 f"stage chunk {chunk} (= batch {B} / {S} stages) must divide "
-                f"over {tiles} tiles for the batch_split junction; choose "
-                f"batch = {groups} * microbatch with (B/S) % tiles == 0"
+                f"over junction degree {degree} for the batch_split junction; "
+                f"choose batch = {groups} * microbatch with (B/S) % degree == 0"
             )
         s_idx = lax.axis_index("stage")
         xs = lax.dynamic_slice_in_dim(x_tile, s_idx * chunk, chunk, axis=0)
@@ -260,7 +278,7 @@ def _make_sp_step(
                 c = dataclasses.replace(sp_ctx, bn_sink=sink)
             else:
                 sink, c = None, sp_ctx
-            act = spp.model.apply(ps, xx, c, start=0, stop=su)
+            act, _ = apply_spatial_region(spp.model, ps, xx, c, levels)
             if not with_stats_sp:
                 return act, jnp.zeros((0,), jnp.float32)
             leaves = jax.tree.leaves(ps)
@@ -274,9 +292,9 @@ def _make_sp_step(
             region = jax.checkpoint(region)
         act, sp_stats = region(params_sp, xs.astype(compute_dtype))
         # Junction: mosaic-merge tiles; batch-split for LOCAL_DP_LP.
-        act = gather_spatial(act, sp)
+        act = gather_spatial(act, sp_last)
         if spp.junction == "batch_split":
-            act = scatter_batch_over_tiles(act, sp)
+            act = scatter_batch_over_tiles(act, sp_last, degree=degree)
 
         # Line all stage chunks up in batch order on every device.
         def g(t):
@@ -287,12 +305,12 @@ def _make_sp_step(
 
     def labels_to_parts(labels):
         """The same index transform phase1 applies to images (chunk by stage
-        block, tile batch-split, gather) — applied to labels."""
+        block, junction batch-split, gather) — applied to labels."""
         B = labels.shape[0]
         chunk = B // S
         if spp.junction == "batch_split":
-            k = tile_linear_index(sp)
-            lab = labels.reshape(S, tiles, chunk // tiles)
+            k = junction_shard_index(sp_last, degree)
+            lab = labels.reshape(S, degree, chunk // degree)
             lab = lax.dynamic_index_in_dim(lab, k, axis=1, keepdims=False)
             lab = lab.reshape(-1)
         else:

@@ -8,19 +8,30 @@ LOCAL_DP_LP (``:809-1028``).  On TPU both junctions are one collective:
 
 - ``gather_spatial``: ``lax.all_gather(tiled=True)`` over the spatial axes —
   every device holds the full activation (replicated tail; fine for heads).
-- ``scatter_batch_over_tiles``: gather + slice the batch by the device's tile
-  linear index — the LOCAL_DP_LP junction (each former tile device trains the
-  tail on its own micro-slice of the batch).
+- ``scatter_batch_over_tiles``: gather + slice the batch by the device's
+  junction shard index — the LOCAL_DP_LP junction.  The DP ``degree`` is
+  independent of the tile count (reference ``comm.py:278-294`` lets each LP
+  stage run LOCAL_DP_LP-way data parallelism): with degree < device count the
+  tail is computed redundantly within shard groups, with degree == device
+  count every device trains a distinct batch shard.
 
-``apply_spatial_model`` runs a CellModel with the first ``spatial_until``
-cells under spatial sharding and the rest replicated/batch-split — the analog
-of the reference's spatial model variants that switch conv_spatial off past
-``end_layer`` (amoebanet.py:618-710, resnet_spatial.py:272-296).
+Multi-level spatial parallelism (reference ``num_spatial_parts="4,2"``,
+``train_spatial.py:453-504`` skewed spatial→spatial transitions): levels are
+a list of ``(stop_cell, SpatialCtx)`` where later levels have coarser grids
+on the SAME mesh axes with replication factor ``rep`` (layer_ctx.py).  The
+transition is :func:`respatial` — one all_gather(+dedup) and a re-slice; its
+AD transpose is the reverse re-shard, so the reference's hand-written skewed
+recv-rank machinery has no analog here.
+
+``apply_spatial_model`` runs a CellModel with the leading cells under spatial
+sharding (one or more levels) and the rest replicated/batch-split — the
+analog of the reference's spatial model variants that switch conv_spatial off
+past ``end_layer`` (amoebanet.py:618-710, resnet_spatial.py:272-296).
 """
 
 from __future__ import annotations
 
-from typing import Any, Optional, Tuple, Union
+from typing import Any, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -30,6 +41,7 @@ from mpi4dl_tpu.cells import CellModel
 from mpi4dl_tpu.layer_ctx import ApplyCtx, SpatialCtx
 
 Act = Union[jax.Array, Tuple[jax.Array, ...]]
+Levels = Sequence[Tuple[int, SpatialCtx]]
 
 
 def _map_act(fn, x: Act) -> Act:
@@ -38,43 +50,145 @@ def _map_act(fn, x: Act) -> Act:
     return fn(x)
 
 
+def _gather_dedup(t: jax.Array, axis_name: str, dim: int, grid: int, rep: int) -> jax.Array:
+    """all_gather the full extent of `dim` from a (possibly rep-duplicated)
+    tile layout: device order along the axis is grid blocks of rep identical
+    tiles, so the tiled gather is viewed as (grid, rep, local) and the
+    duplicates dropped."""
+    t = lax.all_gather(t, axis_name, axis=dim, tiled=True)
+    if rep > 1:
+        lead = t.shape[:dim]
+        local = t.shape[dim] // (grid * rep)
+        t = t.reshape(*lead, grid, rep, local, *t.shape[dim + 1:])
+        t = lax.index_in_dim(t, 0, axis=dim + 1, keepdims=False)
+        t = t.reshape(*lead, grid * local, *t.shape[dim + 2:])
+    return t
+
+
 def gather_spatial(x: Act, sp: SpatialCtx, h_dim: int = 1, w_dim: int = 2) -> Act:
     """Reassemble the full (global-H/W) tensor from tiles on every device."""
 
     def g(t):
         if sp.axis_h and sp.grid_h > 1:
-            t = lax.all_gather(t, sp.axis_h, axis=h_dim, tiled=True)
+            t = _gather_dedup(t, sp.axis_h, h_dim, sp.grid_h, sp.rep_h)
         if sp.axis_w and sp.grid_w > 1:
-            t = lax.all_gather(t, sp.axis_w, axis=w_dim, tiled=True)
+            t = _gather_dedup(t, sp.axis_w, w_dim, sp.grid_w, sp.rep_w)
         return t
 
     return _map_act(g, x)
 
 
-def tile_linear_index(sp: SpatialCtx) -> jax.Array:
-    """This device's tile index in row-major (reference local_rank ordering,
-    split_input train_spatial.py:241-290)."""
-    idx = jnp.zeros((), jnp.int32)
-    if sp.axis_h and sp.grid_h > 1:
-        idx = idx + lax.axis_index(sp.axis_h) * sp.grid_w
-    if sp.axis_w and sp.grid_w > 1:
-        idx = idx + lax.axis_index(sp.axis_w)
-    return idx
+def tile_device_count(sp: SpatialCtx) -> int:
+    """Total devices on the tile axes (including replication groups)."""
+    nh = sp.grid_h * sp.rep_h if sp.axis_h else 1
+    nw = sp.grid_w * sp.rep_w if sp.axis_w else 1
+    return nh * nw
 
 
-def scatter_batch_over_tiles(x: Act, sp: SpatialCtx) -> Act:
-    """LOCAL_DP_LP junction: full tensor → per-device batch shard."""
-    tiles = sp.grid_h * sp.grid_w
+def junction_shard_index(sp: SpatialCtx, degree: int) -> jax.Array:
+    """This device's batch-shard index for a degree-way LOCAL_DP_LP junction:
+    the tile-axes device grid is linearized row-major and chunked into
+    `degree` contiguous groups (each group redundantly computes one shard)."""
+    total = tile_device_count(sp)
+    assert 1 <= degree <= total and total % degree == 0, (degree, total)
+    lin = jnp.zeros((), jnp.int32)
+    nw = sp.grid_w * sp.rep_w if sp.axis_w else 1
+    if sp.axis_h:
+        lin = lin + lax.axis_index(sp.axis_h) * nw
+    if sp.axis_w:
+        lin = lin + lax.axis_index(sp.axis_w)
+    return lin // (total // degree)
+
+
+def scatter_batch_over_tiles(x: Act, sp: SpatialCtx, degree: Optional[int] = None) -> Act:
+    """LOCAL_DP_LP junction: full tensor → per-device batch shard.
+
+    `degree` defaults to the tile count (the reference's implicit choice when
+    LOCAL_DP_LP == num_spatial_parts); any degree dividing the tile-axes
+    device count is legal (reference comm.py:278-294)."""
+    if degree is None:
+        degree = sp.grid_h * sp.grid_w
     t0 = x[0] if isinstance(x, tuple) else x
     n = t0.shape[0]
-    assert n % tiles == 0, f"batch {n} not divisible by {tiles} tiles"
-    shard = n // tiles
-    start = tile_linear_index(sp) * shard
+    assert n % degree == 0, f"batch {n} not divisible by junction degree {degree}"
+    shard = n // degree
+    start = junction_shard_index(sp, degree) * shard
 
     def s(t):
         return lax.dynamic_slice_in_dim(t, start, shard, axis=0)
 
     return _map_act(s, x)
+
+
+def respatial(x: Act, sp_from: SpatialCtx, sp_to: SpatialCtx,
+              h_dim: int = 1, w_dim: int = 2) -> Act:
+    """Re-shard an activation from one spatial level's tile layout to
+    another's (the TPU form of the reference's skewed spatial→spatial
+    transition, train_spatial.py:453-504): per dim, gather the full extent
+    (deduplicating any replication) and slice this device's new tile.
+
+    Both levels must live on the same mesh axes (grid*rep equal per axis).
+    Works for coarsening and refinement; AD gives the reverse re-shard."""
+
+    def dim_pass(t, axis, dim, g_from, r_from, g_to, r_to):
+        if axis is None or g_from == g_to:
+            assert g_from == g_to, (g_from, g_to)
+            return t
+        assert g_from * r_from == g_to * r_to, (
+            f"levels disagree on axis size: {g_from}*{r_from} != {g_to}*{r_to}"
+        )
+        full = _gather_dedup(t, axis, dim, g_from, r_from) if g_from > 1 else t
+        if g_to == 1:
+            return full
+        local = full.shape[dim] // g_to
+        idx = lax.axis_index(axis) // r_to
+        return lax.dynamic_slice_in_dim(full, idx * local, local, axis=dim)
+
+    def r(t):
+        t = dim_pass(t, sp_from.axis_h, h_dim, sp_from.grid_h, sp_from.rep_h,
+                     sp_to.grid_h, sp_to.rep_h)
+        t = dim_pass(t, sp_from.axis_w, w_dim, sp_from.grid_w, sp_from.rep_w,
+                     sp_to.grid_w, sp_to.rep_w)
+        return t
+
+    return _map_act(r, x)
+
+
+def apply_spatial_region(
+    model: CellModel,
+    params_list,
+    x: Act,
+    ctx: ApplyCtx,
+    levels: Levels,
+) -> Tuple[Act, SpatialCtx]:
+    """Run the spatial region: cells [0, stop_i) per level with that level's
+    SpatialCtx, respatial transitions between levels.  Returns the activation
+    (still tiled per the LAST level's layout) and that last ctx.
+
+    A fully-degenerate level (grid 1x1 — every device holds the whole image,
+    e.g. the tail of a "4,2,1" chain) runs with ``spatial=None`` and the tile
+    axes added to ``bn_stat_axes``: compute is replicated, and BN deposits
+    pmean over the former tile axes so the written-back running stats are
+    provably replicated (shard_map vma bookkeeping)."""
+    import dataclasses
+
+    tile_axes = tuple(a for a in (levels[0][1].axis_h, levels[0][1].axis_w) if a)
+    start = 0
+    prev: Optional[SpatialCtx] = None
+    for stop, sp_l in levels:
+        assert stop > start, f"empty spatial level [{start}, {stop})"
+        if prev is not None:
+            x = respatial(x, prev, sp_l)
+        if sp_l.active:
+            c = ctx.with_spatial(sp_l)
+        else:
+            c = dataclasses.replace(
+                ctx.with_spatial(None), bn_stat_axes=ctx.bn_stat_axes + tile_axes
+            )
+        x = model.apply(params_list, x, c, start=start, stop=stop)
+        start, prev = stop, sp_l
+    assert prev is not None
+    return x, prev
 
 
 def apply_spatial_model(
@@ -84,23 +198,28 @@ def apply_spatial_model(
     ctx: ApplyCtx,
     spatial_until: Optional[int] = None,
     junction: str = "gather",
+    levels: Optional[Levels] = None,
+    local_dp: Optional[int] = None,
 ) -> Act:
-    """Run cells [0, spatial_until) spatially sharded, junction, then the tail
-    replicated (junction='gather') or batch-split (junction='batch_split').
+    """Run the spatial region (one or more levels), junction, then the tail
+    replicated (junction='gather') or batch-split (junction='batch_split',
+    degree `local_dp` or the final level's tile count).
 
-    Must be called inside shard_map with ctx.spatial set.  With
-    spatial_until=None, all cells except the final head run spatially (safe
-    because heads flatten/pool to per-image vectors).
+    Must be called inside shard_map with ctx.spatial set (level-0 ctx).  With
+    spatial_until=None and no levels, all cells except the final head run
+    spatially (safe because heads flatten/pool to per-image vectors).
     """
     sp = ctx.spatial
     assert sp is not None and sp.active, "apply_spatial_model needs an active SpatialCtx"
-    if spatial_until is None:
-        spatial_until = model.spatial_until or (len(model.cells) - 1)
+    if levels is None:
+        if spatial_until is None:
+            spatial_until = model.spatial_until or (len(model.cells) - 1)
+        levels = [(spatial_until, sp)]
 
-    x = model.apply(params_list, x, ctx, start=0, stop=spatial_until)
-    x = gather_spatial(x, sp)
+    x, sp_last = apply_spatial_region(model, params_list, x, ctx, levels)
+    x = gather_spatial(x, sp_last)
     if junction == "batch_split":
-        x = scatter_batch_over_tiles(x, sp)
+        x = scatter_batch_over_tiles(x, sp_last, degree=local_dp)
     # BN running-stat deposits in the tail must pmean over the former tile
     # axes: under 'batch_split' the batch genuinely varies per tile device;
     # under 'gather' the all_gathered values are equal but shard_map's
@@ -112,4 +231,4 @@ def apply_spatial_model(
     tail_ctx = dataclasses.replace(
         ctx.with_spatial(None), bn_stat_axes=ctx.bn_stat_axes + tile_axes
     )
-    return model.apply(params_list, x, tail_ctx, start=spatial_until)
+    return model.apply(params_list, x, tail_ctx, start=levels[-1][0])

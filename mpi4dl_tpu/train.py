@@ -258,31 +258,42 @@ def make_spatial_train_step(
     spatial_until: Optional[int] = None,
     junction: str = "gather",
     bn_stats: bool = True,
+    levels=None,
+    local_dp: Optional[int] = None,
 ):
     """SP(+DP) training step: one shard_map over the whole step.
 
     Inside, convs/pools halo-exchange over sph/spw; after `spatial_until`
     cells the activation is gathered (SP→LP junction; 'batch_split' = the
-    LOCAL_DP_LP variant); gradients are psum'd over the spatial axes (+ data
-    axis when present) — the spatial tile group being a gradient DP group is
-    exactly reference comm.py:197-248.
+    LOCAL_DP_LP variant, degree `local_dp`); gradients are psum'd over the
+    spatial axes (+ data axis when present) — the spatial tile group being a
+    gradient DP group is exactly reference comm.py:197-248.
+
+    ``levels`` is a list of (stop_cell, SpatialCtx) for multi-level spatial
+    parallelism (reference num_spatial_parts="4,2"); ``sp`` must be the
+    level-0 ctx (it defines the mesh axes and the input sharding).
     """
-    from mpi4dl_tpu.parallel.spatial import apply_spatial_model, tile_linear_index
+    from mpi4dl_tpu.parallel.spatial import (
+        apply_spatial_model,
+        junction_shard_index,
+    )
 
     ctx = ApplyCtx(train=True, spatial=sp, data_axis="data" if with_data_axis else None)
+    sp_last = levels[-1][1] if levels else sp
+    degree = local_dp if local_dp else sp_last.grid_h * sp_last.grid_w
 
     def loss_fn(params_list, x, labels):
         c = dataclasses.replace(ctx, bn_sink={}) if bn_stats else ctx
         logits = apply_spatial_model(
-            model, params_list, x, c, spatial_until=spatial_until, junction=junction
+            model, params_list, x, c, spatial_until=spatial_until,
+            junction=junction, levels=levels, local_dp=local_dp,
         )
         if isinstance(logits, tuple):
             logits = logits[0]
         if junction == "batch_split":
-            tiles = sp.grid_h * sp.grid_w
-            shard = labels.shape[0] // tiles
+            shard = labels.shape[0] // degree
             labels = lax.dynamic_slice_in_dim(
-                labels, tile_linear_index(sp) * shard, shard, axis=0
+                labels, junction_shard_index(sp_last, degree) * shard, shard, axis=0
             )
         stats = stat_updates_from_sink(c.bn_sink, params_list) if bn_stats else None
         return cross_entropy(logits, labels, from_probs), (logits, labels, stats)
@@ -408,11 +419,16 @@ def make_spatial_eval_step(
     from_probs: bool = False,
     spatial_until: Optional[int] = None,
     junction: str = "gather",
+    levels=None,
+    local_dp: Optional[int] = None,
 ):
     """SP(+DP) inference step: tiles in, metrics out (train=False)."""
     from jax import shard_map
 
-    from mpi4dl_tpu.parallel.spatial import apply_spatial_model, tile_linear_index
+    from mpi4dl_tpu.parallel.spatial import (
+        apply_spatial_model,
+        junction_shard_index,
+    )
 
     ctx = ApplyCtx(
         train=False, spatial=sp, data_axis="data" if with_data_axis else None
@@ -422,19 +438,21 @@ def make_spatial_eval_step(
         red_axes = ("data",) + red_axes
     x_spec = spatial_partition_spec(sp, data=with_data_axis)
     y_spec = P("data") if with_data_axis else P()
+    sp_last = levels[-1][1] if levels else sp
+    degree = local_dp if local_dp else sp_last.grid_h * sp_last.grid_w
 
     def sharded_eval(params_list, x, labels):
         logits = apply_spatial_model(
             model, params_list, x.astype(compute_dtype), ctx,
             spatial_until=spatial_until, junction=junction,
+            levels=levels, local_dp=local_dp,
         )
         if isinstance(logits, tuple):
             logits = logits[0]
         if junction == "batch_split":
-            tiles = sp.grid_h * sp.grid_w
-            shard = labels.shape[0] // tiles
+            shard = labels.shape[0] // degree
             labels = lax.dynamic_slice_in_dim(
-                labels, tile_linear_index(sp) * shard, shard, axis=0
+                labels, junction_shard_index(sp_last, degree) * shard, shard, axis=0
             )
         return {
             "loss": lax.pmean(cross_entropy(logits, labels, from_probs), red_axes),
