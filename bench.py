@@ -294,12 +294,15 @@ def _try_rung(name, platform, image_size, num_layers, num_filters,
     return result, err
 
 
-def _max_trainable_px(start: int = 2048, cap: int = 16384) -> tuple[int, dict]:
+def _max_trainable_px(start: int = 2048, cap: int = 16384,
+                      known_fit: int = 0) -> tuple[int, dict]:
     """Largest square resolution whose bs1 step completes on the chip.
 
     Doubling ladder from `start`, then one midpoint refinement between the
     last success and first failure.  Every attempt is a subprocess; any
-    death (OOM, crash, timeout) counts as 'does not fit'.
+    death (OOM, crash, timeout) counts as 'does not fit'.  ``known_fit``
+    seeds the ladder with a resolution another rung already proved (avoids
+    re-paying its multi-minute compile+step).
     """
     attempts = {}
 
@@ -313,7 +316,7 @@ def _max_trainable_px(start: int = 2048, cap: int = 16384) -> tuple[int, dict]:
         print(f"[bench] probe {px}px: {'fits' if ok else 'FAILS'}", file=sys.stderr)
         return ok
 
-    best, px = 0, start
+    best, px = known_fit, max(start, known_fit * 2)
     while px <= cap:
         if not fits(px):
             break
@@ -378,9 +381,15 @@ def main() -> int:
             }}
         else:
             headline["rungs"] = {"2048": {"error": (err or "")[-200:]}}
-        # Max trainable resolution per chip (driver north-star metric).
+        # Max trainable resolution per chip (driver north-star metric).  The
+        # 2048 rung above already proved (or failed) that resolution — seed
+        # the ladder instead of re-compiling it.
         print("[bench] max-resolution probe", file=sys.stderr)
-        best, attempts = _max_trainable_px()
+        rung_ok = bool(r2048 is not None and not r2048.get("error"))
+        best, attempts = _max_trainable_px(
+            start=1024 if not rung_ok else 4096,
+            known_fit=2048 if rung_ok else 0,
+        )
         headline["max_trainable_px"] = best
         headline["max_trainable_px_attempts"] = attempts
 

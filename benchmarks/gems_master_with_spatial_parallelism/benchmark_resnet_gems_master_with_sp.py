@@ -1,7 +1,7 @@
 """resnet gems_sp benchmark (reference: benchmarks/gems_master_with_spatial_parallelism/benchmark_resnet_gems_master_with_sp.py).
 
-Example (8-device CPU mesh smoke run):
-  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+Example (CPU smoke run; the runner provisions the virtual CPU mesh itself):
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
   python gems_master_with_spatial_parallelism/benchmark_resnet_gems_master_with_sp.py --image-size 32 --num-layers 1 --batch-size 8 --steps-per-epoch 3
 """
 

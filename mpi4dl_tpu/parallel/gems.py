@@ -69,7 +69,10 @@ def make_gems_train_step(
     grad_axes: Tuple[str, ...] = ("data",) if with_data_axis else ()
 
     with_stats = bn_stats and part.stat_max > 0
-    branches = make_stage_branches(part, ctx, compute_dtype, remat, with_stats)
+    branches = make_stage_branches(
+        part, ctx, compute_dtype, remat, with_stats,
+        vary_axes=("stage",) + grad_axes,
+    )
 
     def sharded_step(param_row, opt_state, x, labels):
         flat_params = param_row[0]

@@ -81,10 +81,12 @@ def make_pipeline_train_step(
     Pn = parts
     ctx = ApplyCtx(train=True)
 
-    with_stats = bn_stats and part.stat_max > 0
-    branches = make_stage_branches(part, ctx, compute_dtype, remat, with_stats)
-
     grad_axes: Tuple[str, ...] = ("data",) if with_data_axis else ()
+    with_stats = bn_stats and part.stat_max > 0
+    branches = make_stage_branches(
+        part, ctx, compute_dtype, remat, with_stats,
+        vary_axes=("stage",) + grad_axes,
+    )
 
     def sharded_step(param_row, opt_state, x, labels):
         # param_row: [1, Pmax] local stage block; squeeze to [Pmax].

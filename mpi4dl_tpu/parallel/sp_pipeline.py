@@ -252,7 +252,8 @@ def _make_sp_step(
     with_stats_sp = bn_stats and bool(spp.sp_stat_leaf_ids)
     with_stats_tail = bn_stats and part.stat_max > 0
     branches = make_stage_branches(
-        part, tail_ctx, compute_dtype, remat, with_stats_tail
+        part, tail_ctx, compute_dtype, remat, with_stats_tail,
+        vary_axes=("stage",) + tile_axes + grad_axes,
     )
 
     def phase1(sp_flat, x_tile):

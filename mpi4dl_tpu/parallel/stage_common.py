@@ -31,6 +31,7 @@ def make_stage_branches(
     compute_dtype,
     remat: bool,
     with_stats: bool = False,
+    vary_axes: Tuple[str, ...] = (),
 ) -> List[Callable]:
     """One pure-compute branch per stage: unpack flat activation → run the
     stage's cells → pack/pad the output activation (reference per-rank
@@ -40,7 +41,12 @@ def make_stage_branches(
     second element carries the stage's UPDATED BN running stats (fp32, in the
     stage packing's slot order, zero-padded) when ``with_stats``; callers mask
     out bubble-tick garbage and scatter the average back into the stage's
-    flat param row.  stat_max may be 0 (no BN / stats disabled)."""
+    flat param row.  stat_max may be 0 (no BN / stats disabled).
+
+    ``vary_axes``: mesh axes the engine's activations vary over.  A stage
+    with NO stat leaves returns constant zeros for its stats slot, which
+    lax.switch rejects against sibling branches whose (activation-derived)
+    stats vary over those axes — the zeros are pcast to match."""
     stat_n = part.stat_max if with_stats else 0
 
     def stage_branch(s: int):
@@ -67,12 +73,18 @@ def make_stage_branches(
             vals = [
                 sink.get(id(leaves[i]), leaves[i]) for i in part.stat_leaf_ids[s]
             ]
-            svec = (
-                jnp.concatenate([jnp.ravel(v).astype(jnp.float32) for v in vals])
-                if vals
-                else jnp.zeros((0,), jnp.float32)
-            )
-            return out, pad_to(svec, stat_n)
+            if vals:
+                svec = pad_to(
+                    jnp.concatenate(
+                        [jnp.ravel(v).astype(jnp.float32) for v in vals]
+                    ),
+                    stat_n,
+                )
+            else:
+                svec = jnp.zeros((stat_n,), jnp.float32)
+                if vary_axes:
+                    svec = lax.pcast(svec, tuple(vary_axes), to="varying")
+            return out, svec
 
         return jax.checkpoint(fn) if remat else fn
 

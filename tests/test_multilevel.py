@@ -202,6 +202,35 @@ def test_amoeba_cell_d2_rep_layout_matches_fine_grid(devices8):
     )
 
 
+def test_sp_pipeline_statless_stage_branch(devices8):
+    """A pipeline tail mixing BN and BN-free stages must compile: the BN-free
+    stage's zero stats vector is pcast to match its siblings' varying stats
+    (lax.switch vma uniformity — crashed the flagship '4,2' resnet CLI)."""
+    from mpi4dl_tpu.parallel.sp_pipeline import (
+        SPPipeline,
+        init_sp_pipeline_state,
+        make_sp_pipeline_train_step,
+    )
+
+    cells = [
+        LayerCell([Conv2d(3, 8, 3), ReLU()], name="sp0"),
+        LayerCell([Conv2d(8, 8, 3, stride=2), BatchNorm(8), ReLU()], name="t0"),
+        LayerCell([Flatten(), Dense(8 * 16 * 16, 10)], name="head"),  # no BN
+    ]
+    model = CellModel(cells, (2, 32, 32, 3), 10, spatial_until=1)
+    params, _ = model.init(jax.random.key(0))
+    sp = SpatialCtx(axis_h="sph", axis_w="spw", grid_h=2, grid_w=2)
+    mesh = build_mesh(MeshSpec(stage=2, sph=2, spw=2), jax.devices()[:8])
+    spp = SPPipeline.build(model, params, 2, sp, 2, junction="gather")
+    opt = Optimizer("sgd", lr=0.01)
+    step = make_sp_pipeline_train_step(spp, opt, mesh, parts=2)
+    state = init_sp_pipeline_state(spp, params, opt, mesh)
+    x = jax.random.normal(jax.random.key(4), (4, 32, 32, 3))
+    y = jnp.arange(4, dtype=jnp.int32) % 10
+    state, m = step(state, x, y)
+    assert np.isfinite(float(m["loss"]))
+
+
 def test_multilevel_sp_pipeline_exact(devices8):
     """SP x PP with a two-level spatial region (stage=2 x sph=2 x spw=2):
     matches single-device micro-batched SGD exactly on a BN-free model."""
