@@ -114,6 +114,11 @@ def build_train(cfg: ParallelConfig, family: str, mesh):
     opt = Optimizer(cfg.optimizer, lr=cfg.lr, momentum=cfg.momentum)
     dp = cfg.data_parallel
     dtype = cfg.compute_dtype
+    pdtype = cfg.param_dtype
+    if cfg.precision == "bf_16_all":
+        # bf_16_all: parameters stored bf16 as well (reference parser.py
+        # precision vocabulary); fp32 update arithmetic lives in Optimizer.
+        params = jax.tree.map(lambda p: p.astype(pdtype), params)
     from_probs = cfg.softmax_in_model
 
     if family == "lp":
@@ -136,7 +141,7 @@ def build_train(cfg: ParallelConfig, family: str, mesh):
         part = StagePartition.build(
             model, params, cfg.split_size,
             (mb, cfg.image_size, cfg.image_size, 3),
-            balance=cfg.balance, compute_dtype=dtype,
+            balance=cfg.balance, compute_dtype=dtype, param_dtype=pdtype,
         )
         step = make_pipeline_train_step(
             part, opt, mesh, cfg.parts, compute_dtype=dtype, remat=cfg.remat,
@@ -162,7 +167,7 @@ def build_train(cfg: ParallelConfig, family: str, mesh):
         part = StagePartition.build(
             model, params, cfg.split_size,
             (mb, cfg.image_size, cfg.image_size, 3),
-            balance=cfg.balance, compute_dtype=dtype,
+            balance=cfg.balance, compute_dtype=dtype, param_dtype=pdtype,
         )
         step = make_gems_train_step(
             part, opt, mesh, cfg.parts, times=cfg.times, compute_dtype=dtype,
@@ -207,7 +212,7 @@ def build_train(cfg: ParallelConfig, family: str, mesh):
     spp = SPPipeline.build(
         model, params, max(cfg.split_size, 2), sp, microbatch=micro,
         junction=junction, balance=cfg.balance, compute_dtype=dtype,
-        levels=levels, local_dp=local_dp,
+        levels=levels, local_dp=local_dp, param_dtype=pdtype,
     )
     if family == "gems_sp":
         step = make_sp_gems_train_step(

@@ -152,6 +152,11 @@ class StagePartition:
     stat_slots: List[List[Tuple[int, int]]] = dataclasses.field(default_factory=list)
     stat_max: int = 0
     stat_idx: Optional[np.ndarray] = None  # [S, stat_max] int32
+    # Storage dtype of the flat parameter buffers (reference --precision
+    # bf_16_all: everything, params included, in bf16 — halves the stage
+    # buffers, the GEMS mirror ppermute traffic, and the grad cotangents;
+    # update arithmetic stays fp32 inside Optimizer).
+    param_dtype: Any = jnp.float32
 
     @property
     def num_stages(self) -> int:
@@ -166,6 +171,7 @@ class StagePartition:
         microbatch_shape: Any,
         balance: Optional[Sequence[int]] = None,
         compute_dtype=jnp.float32,
+        param_dtype=jnp.float32,
     ) -> "StagePartition":
         """``microbatch_shape`` is either a plain shape tuple or a pytree of
         ``jax.ShapeDtypeStruct`` (tuple activations entering stage 0 — the
@@ -210,16 +216,24 @@ class StagePartition:
         )
         return cls(
             model, ranges, param_packs, act_packs, out_pack, param_max, act_max,
-            stat_leaf_ids, stat_slots, stat_max, stat_idx,
+            stat_leaf_ids, stat_slots, stat_max, stat_idx, param_dtype,
         )
 
     # ---- parameter buffers ----
 
     def pack_params(self, params_list) -> jax.Array:
-        """[S, param_max] fp32 buffer (row s = stage s's flat params)."""
+        """[S, param_max] buffer in ``param_dtype`` (row s = stage s's flat
+        params)."""
         rows = []
         for (r0, r1), pk in zip(self.ranges, self.param_packs):
-            rows.append(pad_to(pk.pack([params_list[i] for i in range(r0, r1)]), self.param_max))
+            rows.append(
+                pad_to(
+                    pk.pack(
+                        [params_list[i] for i in range(r0, r1)], self.param_dtype
+                    ),
+                    self.param_max,
+                )
+            )
         return jnp.stack(rows)
 
     def unpack_params(self, buf: jax.Array) -> List[Any]:

@@ -102,6 +102,8 @@ class SPPipeline:
     # Junction batch-split degree (LOCAL_DP_LP, reference comm.py:278-294);
     # defaults to the final level's tile count.
     degree: int = 1
+    # Storage dtype of sp_buf / tail_buf (bf_16_all — see StagePartition).
+    param_dtype: Any = jnp.float32
 
     @classmethod
     def build(
@@ -116,6 +118,7 @@ class SPPipeline:
         compute_dtype=jnp.float32,
         levels: Optional[list] = None,
         local_dp: Optional[int] = None,
+        param_dtype=jnp.float32,
     ) -> "SPPipeline":
         su = model.spatial_until
         assert 0 < su < len(model.cells), f"spatial_until={su} must split the model"
@@ -152,7 +155,7 @@ class SPPipeline:
         )
         tail_part = StagePartition.build(
             tail_model, params_list[su:], split_size, tail_in,
-            balance=balance, compute_dtype=compute_dtype,
+            balance=balance, compute_dtype=compute_dtype, param_dtype=param_dtype,
         )
         sp_pack = TreePack.of(params_list[:su])
         sp_ids, sp_slots = stat_leaf_info(params_list[:su])
@@ -165,11 +168,11 @@ class SPPipeline:
         )
         return cls(
             model, su, sp, sp_pack, tail_part, junction, mb_tail, sp_ids, sp_idx,
-            levels=levels, degree=degree,
+            levels=levels, degree=degree, param_dtype=param_dtype,
         )
 
     def pack_spatial(self, params_list) -> jax.Array:
-        return self.sp_pack.pack(params_list[: self.spatial_until])
+        return self.sp_pack.pack(params_list[: self.spatial_until], self.param_dtype)
 
     def unpack_all(self, sp_vec, tail_buf) -> list:
         """Reassemble the full params_list (host-side)."""

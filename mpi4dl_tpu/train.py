@@ -58,6 +58,16 @@ def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
 
 @dataclasses.dataclass(frozen=True)
 class Optimizer:
+    """SGD(+momentum) / Adam with fp32 update arithmetic.
+
+    Optimizer state (velocity, moments) is always fp32 and the update is
+    computed in fp32 regardless of the parameter storage dtype, then rounded
+    back — so ``--precision bf_16_all`` (params stored bf16, config.py) keeps
+    fp32 math in the update path.  No persistent fp32 master copy is kept: a
+    master would cost 4 extra bytes/param (6 vs 4 B — *negating* the memory
+    capability the mode exists for) and would desynchronize from the BN
+    running-stat write-back, which targets the live parameter buffer."""
+
     kind: str = "sgd"
     lr: float = 0.001
     momentum: float = 0.0
@@ -65,34 +75,52 @@ class Optimizer:
     b2: float = 0.999
     eps: float = 1e-8
 
+    @staticmethod
+    def _zeros32(params):
+        return jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
     def init(self, params):
         if self.kind == "sgd" and self.momentum == 0.0:
             return ()
         if self.kind == "sgd":
-            return (jax.tree.map(jnp.zeros_like, params),)
+            return (self._zeros32(params),)
         if self.kind == "adam":
-            z = jax.tree.map(jnp.zeros_like, params)
-            return (z, jax.tree.map(jnp.zeros_like, params), jnp.zeros((), jnp.int32))
+            return (
+                self._zeros32(params),
+                self._zeros32(params),
+                jnp.zeros((), jnp.int32),
+            )
         raise ValueError(self.kind)
 
     def update(self, params, grads, opt_state):
+        f32 = jnp.float32
         if self.kind == "sgd" and self.momentum == 0.0:
-            new = jax.tree.map(lambda p, g: p - self.lr * g.astype(p.dtype), params, grads)
+            new = jax.tree.map(
+                lambda p, g: (p.astype(f32) - self.lr * g.astype(f32)).astype(p.dtype),
+                params, grads,
+            )
             return new, ()
         if self.kind == "sgd":
             (vel,) = opt_state
-            vel = jax.tree.map(lambda v, g: self.momentum * v + g.astype(v.dtype), vel, grads)
-            new = jax.tree.map(lambda p, v: p - self.lr * v, params, vel)
+            vel = jax.tree.map(
+                lambda v, g: self.momentum * v + g.astype(f32), vel, grads
+            )
+            new = jax.tree.map(
+                lambda p, v: (p.astype(f32) - self.lr * v).astype(p.dtype),
+                params, vel,
+            )
             return new, (vel,)
         if self.kind == "adam":
             m, v, t = opt_state
             t = t + 1
-            m = jax.tree.map(lambda a, g: self.b1 * a + (1 - self.b1) * g.astype(a.dtype), m, grads)
-            v = jax.tree.map(lambda a, g: self.b2 * a + (1 - self.b2) * jnp.square(g.astype(a.dtype)), v, grads)
-            bc1 = 1 - self.b1 ** t.astype(jnp.float32)
-            bc2 = 1 - self.b2 ** t.astype(jnp.float32)
+            m = jax.tree.map(lambda a, g: self.b1 * a + (1 - self.b1) * g.astype(f32), m, grads)
+            v = jax.tree.map(lambda a, g: self.b2 * a + (1 - self.b2) * jnp.square(g.astype(f32)), v, grads)
+            bc1 = 1 - self.b1 ** t.astype(f32)
+            bc2 = 1 - self.b2 ** t.astype(f32)
             new = jax.tree.map(
-                lambda p, mm, vv: p - self.lr * (mm / bc1) / (jnp.sqrt(vv / bc2) + self.eps),
+                lambda p, mm, vv: (
+                    p.astype(f32) - self.lr * (mm / bc1) / (jnp.sqrt(vv / bc2) + self.eps)
+                ).astype(p.dtype),
                 params, m, v,
             )
             return new, (m, v, t)

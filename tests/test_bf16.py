@@ -1,0 +1,118 @@
+"""--precision bf_16_all: bf16 parameter STORAGE (reference parser.py
+precision vocabulary) with fp32 update arithmetic in the optimizer.
+
+The mode exists for memory capability: it halves the flat stage buffers, the
+GEMS mirror-exchange traffic, and the gradient cotangents.  No fp32 master
+copy is kept (it would cost 6 B/param vs fp32's 4 — negating the point); the
+documented trade is bf16 rounding of each parameter update.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpi4dl_tpu.cells import CellModel, LayerCell
+from mpi4dl_tpu.layers import BatchNorm, Conv2d, Dense, Flatten, ReLU
+from mpi4dl_tpu.mesh import MeshSpec, build_mesh
+from mpi4dl_tpu.parallel.partition import StagePartition
+from mpi4dl_tpu.parallel.pipeline import (
+    init_pipeline_state,
+    make_pipeline_train_step,
+)
+from mpi4dl_tpu.train import Optimizer, TrainState, make_train_step
+
+
+def _model(batch=4):
+    cells = [
+        LayerCell([Conv2d(3, 8, 3), BatchNorm(8), ReLU()], name="c0"),
+        LayerCell([Conv2d(8, 8, 3, stride=2), ReLU()], name="c1"),
+        LayerCell([Flatten(), Dense(8 * 16 * 16, 10)], name="head"),
+    ]
+    return CellModel(cells, (batch, 32, 32, 3), 10)
+
+
+def test_optimizer_update_is_fp32_arithmetic():
+    """bf16 params: the update must be computed in fp32 and rounded once —
+    NOT accumulated in bf16 (which would lose small updates entirely)."""
+    p = jnp.asarray([1.0, 2.0, 3.0], jnp.bfloat16)
+    g = jnp.asarray([0.5, -0.25, 1.0], jnp.bfloat16)
+    opt = Optimizer("sgd", lr=0.1)
+    new, _ = opt.update(p, g, ())
+    want = (p.astype(jnp.float32) - 0.1 * g.astype(jnp.float32)).astype(jnp.bfloat16)
+    assert new.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(new, np.float32), np.asarray(want, np.float32))
+
+    # momentum / adam state must be fp32 even for bf16 params
+    opt_m = Optimizer("sgd", lr=0.1, momentum=0.9)
+    (vel,) = opt_m.init(p)
+    assert vel.dtype == jnp.float32
+    m, v, t = Optimizer("adam").init(p)
+    assert m.dtype == jnp.float32 and v.dtype == jnp.float32
+
+
+def test_param_buffer_memory_halved():
+    """VERDICT r2 item 8 'done' criterion: bf_16_all measurably halves the
+    packed parameter memory."""
+    model = _model()
+    params, _ = model.init(jax.random.key(0))
+    kw = dict(microbatch_shape=(2, 32, 32, 3))
+    part32 = StagePartition.build(model, params, 2, **kw)
+    part16 = StagePartition.build(model, params, 2, param_dtype=jnp.bfloat16, **kw)
+    buf32 = part32.pack_params(params)
+    buf16 = part16.pack_params(params)
+    assert buf32.dtype == jnp.float32 and buf16.dtype == jnp.bfloat16
+    assert buf16.nbytes * 2 == buf32.nbytes
+    # Round trip: unpack restores shapes/values to bf16 resolution.
+    back = part16.unpack_params(np.asarray(buf16))
+    for a, b in zip(jax.tree.leaves(back), jax.tree.leaves(params)):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=1e-2, atol=1e-2,
+        )
+
+
+def test_bf16_all_pipeline_trains(devices8):
+    """Pipeline engine with bf16 param storage + bf16 compute: loss is finite
+    and decreases; state buffers are really bf16."""
+    model = _model()
+    params, _ = model.init(jax.random.key(0))
+    params16 = jax.tree.map(lambda p: p.astype(jnp.bfloat16), params)
+    part = StagePartition.build(
+        model, params16, 2, (2, 32, 32, 3),
+        compute_dtype=jnp.bfloat16, param_dtype=jnp.bfloat16,
+    )
+    mesh = build_mesh(MeshSpec(stage=2), jax.devices()[:2])
+    opt = Optimizer("sgd", lr=0.05)
+    step = make_pipeline_train_step(part, opt, mesh, parts=2, compute_dtype=jnp.bfloat16)
+    state = init_pipeline_state(part, params16, opt, mesh)
+    assert state.param_buf.dtype == jnp.bfloat16
+
+    x = jax.random.normal(jax.random.key(1), (4, 32, 32, 3))
+    y = jnp.arange(4, dtype=jnp.int32) % 10
+    losses = []
+    for _ in range(4):
+        state, m = step(state, x, y)
+        assert np.isfinite(float(m["loss"]))
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0], losses
+    assert state.param_buf.dtype == jnp.bfloat16
+
+
+def test_bf16_all_single_device_trains():
+    """TrainState path: params cast to bf16 train with fp32 update math."""
+    model = _model()
+    params, _ = model.init(jax.random.key(0))
+    params16 = jax.tree.map(lambda p: p.astype(jnp.bfloat16), params)
+    opt = Optimizer("sgd", lr=0.05)
+    step = make_train_step(model, opt, compute_dtype=jnp.bfloat16)
+    state = TrainState.create(params16, opt)
+    x = jax.random.normal(jax.random.key(2), (4, 32, 32, 3))
+    y = jnp.arange(4, dtype=jnp.int32) % 10
+    losses = []
+    for _ in range(4):
+        state, m = step(state, x, y)
+        losses.append(float(m["loss"]))
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0], losses
+    for leaf in jax.tree.leaves(state.params):
+        assert leaf.dtype == jnp.bfloat16
