@@ -89,11 +89,21 @@ class CifarLikeDataset:
         return xb, y[start : start + batch_size]
 
 
+ENCODED_EXTS = (".ppm", ".bmp", ".jpg", ".jpeg", ".png")
+RAW_EXTS = (".npy", ".rgb", ".bin")
+
+
 @dataclasses.dataclass
 class ImageFolderDataset:
-    """APP=1: directory-per-class image folder.  Uses the native C++ loader
-    when available; else a pure-numpy path supporting .npy and raw .rgb files
-    (PIL is not a baked dependency)."""
+    """APP=1: directory-per-class image folder — the reference reads real
+    encoded images through torchvision ImageFolder
+    (benchmark_amoebanet_sp.py:264-283).  Decode chain per file:
+
+    1. native C++ loader (PPM/BMP built in; JPEG/PNG via system libjpeg /
+       libpng when present at build time) — native/tileloader.cc;
+    2. PIL, when importable (covers any remaining encoded format);
+    3. raw .npy / interleaved-RGB bytes (pure numpy, always works).
+    """
 
     datapath: str
     image_size: int
@@ -110,7 +120,7 @@ class ImageFolderDataset:
             for label, cls in enumerate(classes):
                 cdir = os.path.join(self.datapath, cls)
                 for fn in sorted(os.listdir(cdir)):
-                    if fn.endswith((".npy", ".rgb", ".bin")):
+                    if fn.lower().endswith(RAW_EXTS + ENCODED_EXTS):
                         self._files.append((os.path.join(cdir, fn), label))
             if self.num_classes == 0:
                 self.num_classes = max(1, len(classes))
@@ -120,27 +130,52 @@ class ImageFolderDataset:
     def __len__(self) -> int:
         return max(len(self._files), 1)
 
-    def _load(self, path: str) -> np.ndarray:
-        if path.endswith(".npy"):
-            img = np.load(path)
-        else:
-            from mpi4dl_tpu import data_native
+    def _fit(self, img: np.ndarray) -> np.ndarray:
+        """Center-crop or tile an [H, W, 3] float image to the square target."""
+        h, w = img.shape[:2]
+        if h > self.image_size:
+            o = (h - self.image_size) // 2
+            img = img[o : o + self.image_size]
+        if w > self.image_size:
+            o = (w - self.image_size) // 2
+            img = img[:, o : o + self.image_size]
+        h, w = img.shape[:2]
+        if h < self.image_size or w < self.image_size:
+            reps_h = -(-self.image_size // h)
+            reps_w = -(-self.image_size // w)
+            img = np.tile(img, (reps_h, reps_w, 1))[
+                : self.image_size, : self.image_size
+            ]
+        return np.asarray(img, np.float32)
 
-            native = data_native.load_rgb(path, self.image_size)
+    def _load(self, path: str) -> np.ndarray:
+        from mpi4dl_tpu import data_native
+
+        low = path.lower()
+        if low.endswith(".npy"):
+            return self._fit(np.load(path))
+        if low.endswith(ENCODED_EXTS):
+            native = data_native.load_image(path, self.image_size)
             if native is not None:
                 return native
-            raw = np.fromfile(path, dtype=np.uint8)
-            side = int(math.isqrt(raw.size // 3))
-            img = raw[: side * side * 3].reshape(side, side, 3).astype(np.float32) / 255.0
-        if img.shape[0] != self.image_size:
-            # center-crop or tile to target
-            if img.shape[0] > self.image_size:
-                o = (img.shape[0] - self.image_size) // 2
-                img = img[o : o + self.image_size, o : o + self.image_size]
-            else:
-                reps = -(-self.image_size // img.shape[0])
-                img = np.tile(img, (reps, reps, 1))[: self.image_size, : self.image_size]
-        return np.asarray(img, np.float32)
+            try:  # PIL fallback (not a hard dependency)
+                from PIL import Image
+
+                with Image.open(path) as im:
+                    arr = np.asarray(im.convert("RGB"), np.float32) / 255.0
+                return self._fit(arr)
+            except ImportError:
+                raise RuntimeError(
+                    f"cannot decode {path!r}: the native build lacks this "
+                    "codec and PIL is not importable"
+                )
+        native = data_native.load_rgb(path, self.image_size)
+        if native is not None:
+            return native
+        raw = np.fromfile(path, dtype=np.uint8)
+        side = int(math.isqrt(raw.size // 3))
+        img = raw[: side * side * 3].reshape(side, side, 3).astype(np.float32) / 255.0
+        return self._fit(img)
 
     def batch(self, idx: int, batch_size: int) -> Tuple[np.ndarray, np.ndarray]:
         if not self._files:

@@ -31,15 +31,24 @@ def _source_path() -> str:
 
 
 def _build(src: str, out: str) -> bool:
-    try:
-        r = subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-o", out, src],
-            capture_output=True,
-            timeout=120,
-        )
-        return r.returncode == 0 and os.path.exists(out)
-    except (OSError, subprocess.TimeoutExpired):
-        return False
+    """Compile the loader, probing for optional system codecs: full build
+    (libjpeg + libpng) first, then degrading — the .so always exists if g++
+    does; codecs are compile-gated (tl_codecs() reports what's in)."""
+    base = ["g++", "-O3", "-shared", "-fPIC", "-o", out, src]
+    variants = [
+        base + ["-DHAVE_LIBJPEG", "-DHAVE_LIBPNG", "-ljpeg", "-lpng"],
+        base + ["-DHAVE_LIBJPEG", "-ljpeg"],
+        base + ["-DHAVE_LIBPNG", "-lpng"],
+        base,
+    ]
+    for cmd in variants:
+        try:
+            r = subprocess.run(cmd, capture_output=True, timeout=120)
+        except (OSError, subprocess.TimeoutExpired):
+            return False
+        if r.returncode == 0 and os.path.exists(out):
+            return True
+    return False
 
 
 def get_lib() -> Optional[ctypes.CDLL]:
@@ -67,6 +76,18 @@ def get_lib() -> Optional[ctypes.CDLL]:
             lib = ctypes.CDLL(so)
         except OSError:
             return None
+        # A stale cached .so from an older source (e.g. timestamp-preserving
+        # installs defeating the mtime guard) may lack newer symbols; rebuild
+        # once, then degrade to None rather than raising AttributeError.
+        if not hasattr(lib, "tl_load_image"):
+            if not _build(src, so):
+                return None
+            try:
+                lib = ctypes.CDLL(so)
+            except OSError:
+                return None
+            if not hasattr(lib, "tl_load_image"):
+                return None
         lib.tl_load_rgb.argtypes = [
             ctypes.c_char_p, ctypes.c_int,
             np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
@@ -84,12 +105,39 @@ def get_lib() -> Optional[ctypes.CDLL]:
             np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
         ]
         lib.tl_crop_tiles.restype = None
+        lib.tl_load_image.argtypes = [
+            ctypes.c_char_p, ctypes.c_int,
+            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+        ]
+        lib.tl_load_image.restype = ctypes.c_int
+        lib.tl_codecs.argtypes = []
+        lib.tl_codecs.restype = ctypes.c_int
         _lib = lib
         return _lib
 
 
 def available() -> bool:
     return get_lib() is not None
+
+
+def codecs() -> dict:
+    """Which optional codecs the native build carries."""
+    lib = get_lib()
+    bits = lib.tl_codecs() if lib is not None else 0
+    return {"jpeg": bool(bits & 1), "png": bool(bits & 2)}
+
+
+def load_image(path: str, image_size: int) -> Optional[np.ndarray]:
+    """Native decode of an ENCODED image (PPM/BMP always; JPEG/PNG when the
+    build found the system codecs) → [S, S, 3] float32 in [0,1]; None when
+    unavailable or the format is not supported by this build."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    out = np.empty((image_size, image_size, 3), np.float32)
+    if lib.tl_load_image(path.encode(), image_size, out) != 0:
+        return None
+    return out
 
 
 def load_rgb(path: str, image_size: int) -> Optional[np.ndarray]:
