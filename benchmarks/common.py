@@ -287,6 +287,12 @@ def run(family: str, model: str, argv=None) -> dict:
     parser = get_parser()
     parser.set_defaults(model=model)
     parser.add_argument("--steps-per-epoch", type=int, default=10)
+    parser.add_argument(
+        "--profile-dir", default=None,
+        help="write a jax.profiler trace of the epoch loop (TensorBoard/XProf"
+             " format) — the TPU analog of the reference's CUDA-event phase "
+             "timing (benchmark_resnet_gems_master_with_sp.py:417-440)",
+    )
     args = parser.parse_args(argv)
     cfg = config_from_args(args)
     if cfg.enable_master_comm_opt:
@@ -332,23 +338,33 @@ def run(family: str, model: str, argv=None) -> dict:
     meter = StepMeter(global_batch)
     timer = Timer()
     metrics = {}
-    for epoch in range(cfg.num_epochs):
-        for i, (x, y) in enumerate(
-            _batches(dataset, global_batch, steps, cfg.num_workers)
-        ):
-            timer.start()
-            state, metrics = step(state, x, y)
-            loss = float(metrics["loss"])  # blocks until the step finishes
-            ms = timer.stop()
-            if epoch > 0 or i > 0:  # skip compile step in the meter
-                meter.add(ms)
-            print(
-                f"epoch {epoch} step {i} time_ms {ms:.1f} "
-                f"images_per_sec {global_batch / (ms / 1e3):.3f} "
-                f"loss {loss:.4f} acc {float(metrics['accuracy']):.4f}"
-            )
-        if ckpt_mgr is not None:
-            ckpt_mgr.save(state, step_id=(epoch + 1) * steps)
+    # try/finally: a crash mid-epoch must still flush the profiler trace
+    # (start_trace only buffers; stop_trace writes the files — the crash you
+    # wanted to profile would otherwise leave an empty trace dir).
+    if args.profile_dir:
+        jax.profiler.start_trace(args.profile_dir)
+    try:
+        for epoch in range(cfg.num_epochs):
+            for i, (x, y) in enumerate(
+                _batches(dataset, global_batch, steps, cfg.num_workers)
+            ):
+                timer.start()
+                state, metrics = step(state, x, y)
+                loss = float(metrics["loss"])  # blocks until the step finishes
+                ms = timer.stop()
+                if epoch > 0 or i > 0:  # skip compile step in the meter
+                    meter.add(ms)
+                print(
+                    f"epoch {epoch} step {i} time_ms {ms:.1f} "
+                    f"images_per_sec {global_batch / (ms / 1e3):.3f} "
+                    f"loss {loss:.4f} acc {float(metrics['accuracy']):.4f}"
+                )
+            if ckpt_mgr is not None:
+                ckpt_mgr.save(state, step_id=(epoch + 1) * steps)
+    finally:
+        if args.profile_dir:
+            jax.profiler.stop_trace()
+            print(f"profile trace written to {args.profile_dir}")
     print(meter.summary())
     return {
         "images_per_sec": meter.images_per_sec(),
