@@ -1,0 +1,176 @@
+"""Pallas halo-consuming convolution — the SURVEY §7 "D2 endgame" spike.
+
+The D2 path amortizes halo exchange over fused layer runs (ops/d2.py); its
+hot op is then a stride-1 conv that consumes a pre-exchanged margin: input
+``[H + kh-1, W + kw-1, Cin]`` → VALID conv → ``[H, W, Cout]``.  This module
+implements that op as a Pallas TPU kernel, formulated as implicit GEMM so
+the FLOPs land on the MXU:
+
+    out[y, x, :] = Σ_{dy, dx}  X[y+dy, x+dx, :] @ W[dy, dx, :, :]
+
+Grid = (H tiles, W tiles, Cout tiles).  Each program DMAs its overlapping
+input window HBM→VMEM (windows overlap by the margin, so the input stays
+unblocked in ANY/HBM and the kernel slices with element-granular ``pl.ds``),
+then accumulates the kh·kw shifted ``[TH·TW, Cin] @ [Cin, TCO]`` matmuls in
+an fp32 VMEM scratch.
+
+Scope (deliberate, per VERDICT r3 task 9 "measure, then decide"):
+- forward only — adoption into Conv2d.apply is gated on the micro-benchmark
+  (benchmarks/communication/halo/benchmark_pallas_conv.py) beating XLA's
+  conv by >10% on real hardware; XLA's conv is the production path today.
+- stride 1 (the fused-run hot case; strided convs stay on XLA).
+
+Channel counts are zero-padded to the 128-lane width and H/W to the tile
+grid by the wrapper; the un-padded result is sliced back out.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def _kernel(x_any, w_any, o_ref, xwin, wbuf, acc, sem, wsem,
+            *, kh, kw, th, tw, tcin, n_ci, tco):
+    """One (H-tile, W-tile, Cout-tile) program.
+
+    Cin is chunked in-kernel (`n_ci` static chunks of `tcin`): per chunk the
+    input window and the weight slab are DMA'd from HBM and the kh*kw shifted
+    matmuls accumulate into fp32 scratch — VMEM stays bounded for any depth.
+    With a single chunk the window DMA is guarded on the first Cout tile:
+    scratch persists across the (innermost) Cout grid dimension, so the same
+    window serves every Cout tile instead of being re-read from HBM.
+    """
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    c = pl.program_id(2)
+
+    def win_copy(ci):
+        return pltpu.make_async_copy(
+            x_any.at[
+                pl.ds(i * th, th + kh - 1),
+                pl.ds(j * tw, tw + kw - 1),
+                pl.ds(ci * tcin, tcin),
+            ],
+            xwin,
+            sem,
+        )
+
+    acc[:] = jnp.zeros_like(acc)
+    for ci in range(n_ci):
+        w_dma = pltpu.make_async_copy(
+            w_any.at[:, :, pl.ds(ci * tcin, tcin), pl.ds(c * tco, tco)],
+            wbuf,
+            wsem,
+        )
+        w_dma.start()
+        if n_ci == 1:
+            @pl.when(c == 0)
+            def _():
+                dma = win_copy(0)
+                dma.start()
+                dma.wait()
+        else:
+            dma = win_copy(ci)
+            dma.start()
+            dma.wait()
+        w_dma.wait()
+        for dy in range(kh):
+            for dx in range(kw):
+                xs = xwin[dy : dy + th, dx : dx + tw, :].reshape(th * tw, tcin)
+                acc[:] += jnp.dot(
+                    xs, wbuf[dy, dx], preferred_element_type=jnp.float32
+                )
+    o_ref[:] = acc[:].reshape(th, tw, tco).astype(o_ref.dtype)
+
+
+# Per-program VMEM budget for the input-window scratch (bytes); the window
+# shrinks its Cin chunk until it fits, so deep layers (cin 1024-2048) run
+# instead of dying in an opaque Mosaic allocation error.
+_WINDOW_BUDGET = 6 * 1024 * 1024
+
+
+@functools.partial(
+    jax.jit, static_argnames=("th", "tw", "tco", "tcin", "interpret", "out_dtype")
+)
+def halo_conv2d(
+    x: jax.Array,
+    w: jax.Array,
+    th: int = 64,
+    tw: int = 128,
+    tco: int = 128,
+    tcin: Optional[int] = None,
+    out_dtype=None,
+    interpret: bool = False,
+) -> jax.Array:
+    """VALID stride-1 conv consuming a pre-exchanged margin.
+
+    x: [N, H + kh-1, W + kw-1, Cin] (margin already present — halo-exchanged
+       under SP, or ``jnp.pad`` for the single-device case);
+    w: [kh, kw, Cin, Cout].  Returns [N, H, W, Cout].
+
+    ``tcin``: Cin chunk per in-kernel DMA (default: largest 128-multiple
+    whose window fits the VMEM budget).
+    """
+    n, hp, wp, cin = x.shape
+    kh, kw, wcin, cout = w.shape
+    assert wcin == cin, (wcin, cin)
+    h, wid = hp - (kh - 1), wp - (kw - 1)
+    assert h > 0 and wid > 0, (x.shape, w.shape)
+    out_dtype = out_dtype or x.dtype
+
+    cin_p = _round_up(cin, 128)
+    if tcin is None:
+        win_rows = (th + kh - 1) * (tw + kw - 1) * x.dtype.itemsize
+        tcin = max(128, min(cin_p, (_WINDOW_BUDGET // win_rows) // 128 * 128))
+    assert tcin % 128 == 0, tcin
+    cin_p = _round_up(cin_p, tcin)
+    n_ci = cin_p // tcin
+    cout_p = _round_up(cout, tco)
+    h_p = _round_up(h, th)
+    w_p = _round_up(wid, tw)
+    x_p = jnp.pad(
+        x, ((0, 0), (0, h_p - h), (0, w_p - wid), (0, cin_p - cin))
+    )
+    w_pd = jnp.pad(w, ((0, 0), (0, 0), (0, cin_p - cin), (0, cout_p - cout)))
+
+    grid = (h_p // th, w_p // tw, cout_p // tco)
+    call = pl.pallas_call(
+        functools.partial(
+            _kernel, kh=kh, kw=kw, th=th, tw=tw,
+            tcin=tcin, n_ci=n_ci, tco=tco,
+        ),
+        out_shape=jax.ShapeDtypeStruct((h_p, w_p, cout_p), out_dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (th, tw, tco), lambda i, j, c: (i, j, c), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((th + kh - 1, tw + kw - 1, tcin), x.dtype),
+            pltpu.VMEM((kh, kw, tcin, tco), w.dtype),
+            pltpu.VMEM((th * tw, tco), jnp.float32),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+        interpret=interpret,
+    )
+    y = jax.vmap(call, in_axes=(0, None))(x_p, w_pd)
+    return y[:, :h, :wid, :cout]
+
+
+def conv_flops(n: int, h: int, w: int, cin: int, cout: int, kh: int, kw: int) -> int:
+    """MAC-based FLOPs of the VALID conv (2 flops per MAC)."""
+    return 2 * n * h * w * cin * cout * kh * kw

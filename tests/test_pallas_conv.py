@@ -1,0 +1,59 @@
+"""Pallas halo-consuming conv (ops/pallas_conv.py) vs lax.conv — interpret
+mode on CPU (real-hardware timing lives in
+benchmarks/communication/halo/benchmark_pallas_conv.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi4dl_tpu.ops.pallas_conv import halo_conv2d
+
+
+def _ref_conv(x, w):
+    return jax.lax.conv_general_dilated(
+        x, w, (1, 1), "VALID", dimension_numbers=("NHWC", "HWIO", "NHWC")
+    )
+
+
+@pytest.mark.parametrize(
+    "kh,kw,cin,cout,h,w,th,tw",
+    [
+        (3, 3, 128, 128, 64, 128, 32, 64),   # aligned everything
+        (3, 3, 24, 40, 33, 50, 16, 64),      # channel + spatial padding paths
+        (1, 1, 128, 128, 32, 128, 32, 128),  # pointwise
+        (5, 5, 8, 16, 20, 20, 16, 64),       # larger receptive field
+        (1, 7, 16, 16, 16, 40, 16, 32),      # asymmetric (AmoebaNet 1x7)
+        (3, 3, 128, 300, 32, 64, 16, 64),    # cout > tco: 3 Cout tiles
+    ],
+)
+def test_halo_conv2d_matches_lax(kh, kw, cin, cout, h, w, th, tw):
+    key = jax.random.key(0)
+    k1, k2 = jax.random.split(key)
+    x = jax.random.normal(k1, (1, h + kh - 1, w + kw - 1, cin), jnp.float32)
+    wk = jax.random.normal(k2, (kh, kw, cin, cout), jnp.float32) / (kh * kw)
+    got = halo_conv2d(x, wk, th=th, tw=tw, tco=128, interpret=True)
+    want = _ref_conv(x, wk)
+    assert got.shape == want.shape == (1, h, w, cout)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4)
+
+
+def test_halo_conv2d_cin_chunked():
+    """Deep-layer path: cin above the chunk size runs the in-kernel Cin loop
+    (n_ci > 1) with per-chunk window/weight DMA."""
+    x = jax.random.normal(jax.random.key(3), (1, 18, 34, 300), jnp.float32)
+    wk = jax.random.normal(jax.random.key(4), (3, 3, 300, 64), jnp.float32) / 9
+    got = halo_conv2d(x, wk, th=16, tw=32, tco=64, tcin=128, interpret=True)
+    want = _ref_conv(x, wk)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4)
+
+
+def test_halo_conv2d_batch_and_dtype():
+    x = jax.random.normal(jax.random.key(1), (2, 18, 34, 16), jnp.bfloat16)
+    wk = jax.random.normal(jax.random.key(2), (3, 3, 16, 32), jnp.bfloat16) / 9
+    got = halo_conv2d(x, wk, th=16, tw=32, interpret=True)
+    want = _ref_conv(x.astype(jnp.float32), wk.astype(jnp.float32))
+    assert got.shape == (2, 16, 32, 32) and got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want), rtol=0.1, atol=0.1
+    )
