@@ -94,7 +94,9 @@ def _build_step(image_size: int, num_layers: int, num_filters: int, batch: int =
     opt = Optimizer("sgd", lr=0.001)
     # bf16 compute + per-cell remat: the memory configuration that fits
     # 1024² bs1 on one chip (the reference needs 5 GPUs for this workload).
-    step = make_train_step(model, opt, compute_dtype=jnp.bfloat16, remat=True)
+    step = make_train_step(
+        model, opt, compute_dtype=jnp.bfloat16, remat=True, donate=True
+    )
     state = TrainState.create(params, opt)
     return step, state
 

@@ -128,6 +128,7 @@ def build_train(cfg: ParallelConfig, family: str, mesh):
             step = make_train_step(
                 model, opt, mesh if dp > 1 else None, parts=cfg.parts,
                 compute_dtype=dtype, from_probs=from_probs, remat=cfg.remat,
+                donate=True,
             )
             state = TrainState.create(params, opt)
             return step, state, (lambda s: s.params), cfg.batch_size * dp
@@ -145,7 +146,7 @@ def build_train(cfg: ParallelConfig, family: str, mesh):
         )
         step = make_pipeline_train_step(
             part, opt, mesh, cfg.parts, compute_dtype=dtype, remat=cfg.remat,
-            from_probs=from_probs, with_data_axis=dp > 1,
+            from_probs=from_probs, with_data_axis=dp > 1, donate=True,
         )
         state = init_pipeline_state(part, params, opt, mesh)
         return (
@@ -172,6 +173,7 @@ def build_train(cfg: ParallelConfig, family: str, mesh):
         step = make_gems_train_step(
             part, opt, mesh, cfg.parts, times=cfg.times, compute_dtype=dtype,
             remat=cfg.remat, from_probs=from_probs, with_data_axis=dp > 1,
+            donate=True,
         )
         state = init_pipeline_state(part, params, opt, mesh)
         return (
@@ -194,7 +196,7 @@ def build_train(cfg: ParallelConfig, family: str, mesh):
             model, opt, mesh, sp, parts=cfg.parts, with_data_axis=dp > 1,
             compute_dtype=dtype, from_probs=from_probs,
             spatial_until=model.spatial_until, junction=junction,
-            levels=levels, local_dp=local_dp,
+            levels=levels, local_dp=local_dp, donate=True,
         )
         state = TrainState.create(params, opt)
         return step, state, (lambda s: s.params), cfg.batch_size * dp
@@ -218,11 +220,12 @@ def build_train(cfg: ParallelConfig, family: str, mesh):
         step = make_sp_gems_train_step(
             spp, opt, mesh, cfg.parts, times=cfg.times, compute_dtype=dtype,
             remat=cfg.remat, from_probs=from_probs, with_data_axis=dp > 1,
+            donate=True,
         )
     else:
         step = make_sp_pipeline_train_step(
             spp, opt, mesh, cfg.parts, compute_dtype=dtype, remat=cfg.remat,
-            from_probs=from_probs, with_data_axis=dp > 1,
+            from_probs=from_probs, with_data_axis=dp > 1, donate=True,
         )
     state = init_sp_pipeline_state(spp, params, opt, mesh)
     return (

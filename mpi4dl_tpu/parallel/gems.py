@@ -30,6 +30,7 @@ TPU-native re-design (this module):
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Tuple
 
 import jax
@@ -59,6 +60,7 @@ def make_gems_train_step(
     from_probs: bool = False,
     with_data_axis: bool = False,
     bn_stats: bool = True,
+    donate: bool = False,
 ):
     """Build the GEMS step: x is [2 * times * parts * mb, H, W, C]; the first
     half of each pair flows forward, the second backward."""
@@ -124,7 +126,7 @@ def make_gems_train_step(
         out_specs=(pspec, pspec, P()),
     )
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def step(state: PipelineState, x, labels):
         pb, opt, metrics = smapped(state.param_buf, state.opt_state, x, labels)
         return PipelineState(pb, opt, state.step + 1), metrics

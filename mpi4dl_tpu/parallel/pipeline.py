@@ -30,6 +30,7 @@ mesh, ordering is dataflow.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Tuple
 
 import jax
@@ -72,6 +73,7 @@ def make_pipeline_train_step(
     with_data_axis: bool = False,
     loss_scale: float = 1.0,
     bn_stats: bool = True,
+    donate: bool = False,
 ):
     """Build `(PipelineState, x, labels) -> (PipelineState, metrics)`.
 
@@ -135,7 +137,10 @@ def make_pipeline_train_step(
         out_specs=(pspec, pspec, P()),
     )
 
-    @jax.jit
+    # donate=True: param/opt buffers update in place (one copy, not two, of
+    # the stage buffers at peak).  Off by default: exact-match tests alias
+    # param arrays across states.
+    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def step(state: PipelineState, x, labels):
         pb, opt, metrics = smapped(state.param_buf, state.opt_state, x, labels)
         return PipelineState(pb, opt, state.step + 1), metrics

@@ -48,6 +48,7 @@ explicit (vma bookkeeping), not to combine anything; this is also why a
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Optional, Tuple
 
 import jax
@@ -222,6 +223,7 @@ def _make_sp_step(
     remat: bool,
     with_data_axis: bool,
     bn_stats: bool = True,
+    donate: bool = False,
 ):
     """Shared scaffolding of the SP(+GEMS) x PP steps: phase-1 spatial region,
     junction, tail scan (``scan_fn``), loss reduction, grad combine, update.
@@ -393,7 +395,7 @@ def _make_sp_step(
         out_specs=(P(), tail_spec, P(), tail_spec, P()),
     )
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def step(state: SPPipelineState, x, labels):
         sp_buf, tail_buf, opt_sp, opt_tail, metrics = smapped(
             state.sp_buf, state.tail_buf, state.opt_sp, state.opt_tail, x, labels
@@ -416,6 +418,7 @@ def make_sp_pipeline_train_step(
     from_probs: bool = False,
     with_data_axis: bool = False,
     bn_stats: bool = True,
+    donate: bool = False,
 ):
     """Build `(SPPipelineState, x, labels) -> (SPPipelineState, metrics)`.
 
@@ -437,7 +440,7 @@ def make_sp_pipeline_train_step(
 
     return _make_sp_step(
         spp, optimizer, mesh, (parts,), scan_fn, parts,
-        compute_dtype, remat, with_data_axis, bn_stats,
+        compute_dtype, remat, with_data_axis, bn_stats, donate,
     )
 
 
@@ -452,6 +455,7 @@ def make_sp_gems_train_step(
     from_probs: bool = False,
     with_data_axis: bool = False,
     bn_stats: bool = True,
+    donate: bool = False,
 ):
     """SP x GEMS x PP — the reference's flagship 5D composition
     (``train_spatial_master.py``: two spatial models over mirrored rank sets
@@ -478,5 +482,5 @@ def make_sp_gems_train_step(
 
     return _make_sp_step(
         spp, optimizer, mesh, (times, 2, parts), scan_fn, 2 * times * parts,
-        compute_dtype, remat, with_data_axis, bn_stats,
+        compute_dtype, remat, with_data_axis, bn_stats, donate,
     )

@@ -189,6 +189,7 @@ def make_train_step(
     from_probs: bool = False,
     remat: bool = False,
     bn_stats: bool = True,
+    donate: bool = False,
 ):
     """Single-device or DP (batch sharded over 'data') training step.
 
@@ -249,7 +250,11 @@ def make_train_step(
         )
 
     if mesh is None:
-        return jax.jit(step)
+        # donate=True consumes the caller's state (params/opt buffers update
+        # in place), removing a full extra copy of params+opt from peak
+        # memory — part of the max-trainable-resolution story.  Off by
+        # default: exact-match tests alias param arrays across states.
+        return jax.jit(step, donate_argnums=(0,) if donate else ())
 
     # DP: batch sharded over 'data'; params replicated.  XLA inserts the
     # gradient all-reduce (the reference's SyncAllreduce, comm.py:440-514).
@@ -259,6 +264,7 @@ def make_train_step(
         step,
         in_shardings=(None, data_spec, data_spec),
         out_shardings=(None, None),
+        donate_argnums=(0,) if donate else (),
     )
     return jstep
 
@@ -288,6 +294,7 @@ def make_spatial_train_step(
     bn_stats: bool = True,
     levels=None,
     local_dp: Optional[int] = None,
+    donate: bool = False,
 ):
     """SP(+DP) training step: one shard_map over the whole step.
 
@@ -398,7 +405,7 @@ def make_spatial_train_step(
         out_specs=(P(), P(), P()),
     )
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def step(state: TrainState, x, labels):
         params, opt_state, metrics = smapped(state.params, state.opt_state, x, labels)
         return TrainState(params, opt_state, state.step + 1), metrics
