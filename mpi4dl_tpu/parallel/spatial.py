@@ -120,6 +120,63 @@ def scatter_batch_over_tiles(x: Act, sp: SpatialCtx, degree: Optional[int] = Non
     return _map_act(s, x)
 
 
+def can_all_to_all_junction(sp: SpatialCtx, degree: int) -> bool:
+    """The batch-split junction has an all_to_all fast path when every tile
+    device takes a distinct batch shard (degree == device count) and no
+    replication groups exist — the common LOCAL_DP_LP configuration."""
+    return (
+        sp.rep_h == 1 and sp.rep_w == 1
+        and degree == sp.grid_h * sp.grid_w
+    )
+
+
+def batch_split_all_to_all(x: Act, sp: SpatialCtx,
+                           h_dim: int = 1, w_dim: int = 2) -> Act:
+    """Tile layout → batch-shard layout in one collective per axis.
+
+    Equivalent to ``gather_spatial`` + ``scatter_batch_over_tiles`` with
+    degree == tile count, but moves 1/degree of the bytes and never
+    materializes the full gathered activation on any device (the all_gather
+    path costs degree× both in ICI traffic and junction memory).  Shard
+    order matches :func:`junction_shard_index`: splitting over sph first
+    (outer), then spw, puts batch shard ih*grid_w+iw on device (ih, iw).
+    """
+    assert can_all_to_all_junction(sp, sp.grid_h * sp.grid_w)
+
+    def s(t):
+        if sp.axis_h and sp.grid_h > 1:
+            t = lax.all_to_all(
+                t, sp.axis_h, split_axis=0, concat_axis=h_dim, tiled=True
+            )
+        if sp.axis_w and sp.grid_w > 1:
+            t = lax.all_to_all(
+                t, sp.axis_w, split_axis=0, concat_axis=w_dim, tiled=True
+            )
+        return t
+
+    return _map_act(s, x)
+
+
+def apply_junction(x: Act, sp_last: SpatialCtx, junction: str,
+                   local_dp: Optional[int] = None) -> Act:
+    """The SP→LP junction, shared by the pure-SP and SPxPP engines.
+
+    'gather': full activation everywhere.  'batch_split': per-device batch
+    shard of degree ``local_dp`` (default: final level's tile count), via the
+    all_to_all fast path when every tile device takes a distinct shard."""
+    degree = local_dp if local_dp else sp_last.grid_h * sp_last.grid_w
+    if junction == "batch_split":
+        n = (x[0] if isinstance(x, tuple) else x).shape[0]
+        assert n % degree == 0, (
+            f"batch {n} not divisible by junction degree {degree}"
+        )
+        if can_all_to_all_junction(sp_last, degree):
+            return batch_split_all_to_all(x, sp_last)
+        x = gather_spatial(x, sp_last)
+        return scatter_batch_over_tiles(x, sp_last, degree=degree)
+    return gather_spatial(x, sp_last)
+
+
 def respatial(x: Act, sp_from: SpatialCtx, sp_to: SpatialCtx,
               h_dim: int = 1, w_dim: int = 2) -> Act:
     """Re-shard an activation from one spatial level's tile layout to
@@ -217,9 +274,7 @@ def apply_spatial_model(
         levels = [(spatial_until, sp)]
 
     x, sp_last = apply_spatial_region(model, params_list, x, ctx, levels)
-    x = gather_spatial(x, sp_last)
-    if junction == "batch_split":
-        x = scatter_batch_over_tiles(x, sp_last, degree=local_dp)
+    x = apply_junction(x, sp_last, junction, local_dp)
     # BN running-stat deposits in the tail must pmean over the former tile
     # axes: under 'batch_split' the batch genuinely varies per tile device;
     # under 'gather' the all_gathered values are equal but shard_map's

@@ -268,3 +268,31 @@ def test_multilevel_sp_pipeline_exact(devices8):
     got = spp.unpack_all(np.asarray(state.sp_buf), np.asarray(state.tail_buf))
     for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(ref_state.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=1e-5)
+
+
+def test_batch_split_junction_uses_all_to_all(devices8):
+    """degree == tile devices, rep == 1 → the junction must compile to
+    all_to_all (1/degree the ICI traffic and junction memory of
+    gather+slice), not all_gather; degree < devices falls back."""
+    from mpi4dl_tpu.train import make_spatial_train_step
+
+    model = _bnfree_model(4)
+    params, _ = model.init(jax.random.key(0))
+    sp = SpatialCtx(axis_h="sph", axis_w="spw", grid_h=2, grid_w=2)
+    mesh = build_mesh(MeshSpec(sph=2, spw=2), jax.devices()[:4])
+    opt = Optimizer("sgd", lr=0.01)
+    x = jax.random.normal(jax.random.key(1), (4, 32, 32, 3))
+    y = jnp.arange(4, dtype=jnp.int32) % 10
+
+    def jaxpr_of(local_dp):
+        step = make_spatial_train_step(
+            model, opt, mesh, sp, junction="batch_split",
+            spatial_until=3, local_dp=local_dp,
+        )
+        state = TrainState.create(params, opt)
+        return str(jax.make_jaxpr(lambda s: step(s, x, y))(state))
+
+    fast = jaxpr_of(4)
+    assert "all_to_all" in fast, "a2a junction not taken at degree==devices"
+    slow = jaxpr_of(2)
+    assert "all_to_all" not in slow  # degree 2 on 4 devices: gather+slice
