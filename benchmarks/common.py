@@ -84,6 +84,7 @@ def _spatial_levels(cfg: ParallelConfig, n_cells: int):
         # --fused-layers caps margin-consuming layers per fused exchange
         # (reference resnet_spatial_d2.py get_balance); <=0 → maximal fusion.
         d2_max_fused=cfg.fused_layers if cfg.fused_layers > 0 else None,
+        use_pallas_conv=cfg.pallas_conv,
     )
     levels = []
     for i in range(k):

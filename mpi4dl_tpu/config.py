@@ -49,6 +49,7 @@ class ParallelConfig:
     momentum: float = 0.0
     optimizer: str = "sgd"
     remat: bool = True  # jax.checkpoint each stage application
+    pallas_conv: bool = False  # route eligible SP convs through the Pallas kernel
     checkpoint_dir: Optional[str] = None
     seed: int = 0
 
@@ -135,6 +136,9 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument("--enable-gems", action="store_true")
     p.add_argument("--lr", type=float, default=0.001)
     p.add_argument("--no-remat", action="store_true")
+    p.add_argument("--pallas-conv", action="store_true",
+                   help="use the Pallas margin-consuming conv kernel for "
+                        "eligible spatial convs (see PERF_NOTES.md)")
     p.add_argument("--checkpoint-dir", type=str, default=None)
     p.add_argument("--seed", type=int, default=0)
     return p
@@ -176,6 +180,7 @@ def config_from_args(args: argparse.Namespace) -> ParallelConfig:
         enable_gems=args.enable_gems,
         lr=args.lr,
         remat=not args.no_remat,
+        pallas_conv=args.pallas_conv,
         checkpoint_dir=args.checkpoint_dir,
         seed=args.seed,
     )

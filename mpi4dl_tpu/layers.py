@@ -133,6 +133,23 @@ class Conv2d(Layer):
                 (0, 0) if halo_h.lo else (ph, ph),
                 (0, 0) if halo_w.lo else (pw, pw),
             )
+            if (
+                sp.use_pallas_conv
+                and (sh, sw) == (1, 1)
+                and self.feature_group_count == 1
+            ):
+                # Pallas margin-consuming kernel (ops/pallas_conv.py): wants
+                # the margin present on BOTH dims — explicitly pad any dim
+                # whose padding wasn't realized by halo exchange.
+                from mpi4dl_tpu.ops.pallas_conv import halo_conv2d_t
+
+                pads = [(0, 0), padding[0], padding[1], (0, 0)]
+                if any(p != (0, 0) for p in pads):
+                    x = jnp.pad(x, pads)
+                y = halo_conv2d_t(x, kernel)
+                if self.bias:
+                    y = y + params["bias"].astype(y.dtype)
+                return y
         else:
             padding = ((ph, ph), (pw, pw))
         y = lax.conv_general_dilated(

@@ -144,12 +144,19 @@ def halo_conv2d(
     w_pd = jnp.pad(w, ((0, 0), (0, 0), (0, cin_p - cin), (0, cout_p - cout)))
 
     grid = (h_p // th, w_p // tw, cout_p // tco)
+    # Under shard_map with vma checking, pallas_call must declare how its
+    # output varies across mesh axes: the union of the inputs' vma.
+    try:
+        vma = frozenset(jax.typeof(x).vma) | frozenset(jax.typeof(w).vma)
+        out_struct = jax.ShapeDtypeStruct((h_p, w_p, cout_p), out_dtype, vma=vma)
+    except (AttributeError, TypeError):
+        out_struct = jax.ShapeDtypeStruct((h_p, w_p, cout_p), out_dtype)
     call = pl.pallas_call(
         functools.partial(
             _kernel, kh=kh, kw=kw, th=th, tw=tw,
             tcin=tcin, n_ci=n_ci, tco=tco,
         ),
-        out_shape=jax.ShapeDtypeStruct((h_p, w_p, cout_p), out_dtype),
+        out_shape=out_struct,
         grid=grid,
         in_specs=[
             pl.BlockSpec(memory_space=pl.ANY),
@@ -174,3 +181,57 @@ def halo_conv2d(
 def conv_flops(n: int, h: int, w: int, cin: int, cout: int, kh: int, kw: int) -> int:
     """MAC-based FLOPs of the VALID conv (2 flops per MAC)."""
     return 2 * n * h * w * cin * cout * kh * kw
+
+
+# ---------------------------------------------------------------------------
+# Differentiable wrapper: custom VJP so the kernel can train.
+#
+#   y[n,p,q,co] = Σ_{dy,dx,ci} x[n,p+dy,q+dx,ci] · w[dy,dx,ci,co]
+#   dx[n,a,b,ci] = Σ ct[n,a-dy,b-dx,co] · w[dy,dx,ci,co]
+#              = VALID conv of ct zero-padded by (kh-1, kw-1) with the
+#                spatially-flipped, io-swapped kernel — the SAME primitive.
+#   dw = XLA's conv-backprop-filter (via jax.vjp of the lax reference conv:
+#        a full-spatial reduction that is not this kernel's shape).
+# ---------------------------------------------------------------------------
+
+
+def _auto_interpret(interpret: bool) -> bool:
+    # Pallas TPU kernels need the interpreter on CPU hosts (tests / smoke).
+    return interpret or jax.default_backend() == "cpu"
+
+
+def _lax_valid_conv(x, w):
+    return jax.lax.conv_general_dilated(
+        x, w, (1, 1), "VALID", dimension_numbers=("NHWC", "HWIO", "NHWC")
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def halo_conv2d_t(x: jax.Array, w: jax.Array, interpret: bool = False) -> jax.Array:
+    """Trainable (custom-VJP) form of :func:`halo_conv2d` with default tiles."""
+    return halo_conv2d(x, w, interpret=_auto_interpret(interpret))
+
+
+def _fwd(x, w, interpret):
+    return halo_conv2d(x, w, interpret=_auto_interpret(interpret)), (x, w)
+
+
+def _bwd(interpret, res, ct):
+    x, w = res
+    kh, kw = w.shape[0], w.shape[1]
+    # dx: margin-consuming conv of the padded cotangent with flip+swap(w);
+    # its output is exactly x's (padded-input) shape.
+    ct_pad = jnp.pad(ct, ((0, 0), (kh - 1, kh - 1), (kw - 1, kw - 1), (0, 0)))
+    w_t = jnp.flip(w, axis=(0, 1)).swapaxes(2, 3)
+    dx = halo_conv2d(
+        ct_pad, w_t.astype(ct.dtype), out_dtype=x.dtype,
+        interpret=_auto_interpret(interpret),
+    )
+    # dw: XLA's backprop-filter.  linear_transpose (the conv is linear in w)
+    # avoids jax.vjp's throwaway primal forward on eager backward calls.
+    w_t_fn = jax.linear_transpose(lambda w_: _lax_valid_conv(x, w_), w)
+    (dw,) = w_t_fn(ct.astype(x.dtype))
+    return dx, dw
+
+
+halo_conv2d_t.defvjp(_fwd, _bwd)
