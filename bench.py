@@ -60,7 +60,16 @@ LADDER = [
     ("cpu_smoke", "cpu", 128, 3, 64, 1, 3, 600, False),
 ]
 
-PROBE_TIMEOUT_S = 1500
+PROBE_TIMEOUT_S = 1200
+# Global wall-clock budget: the memory rungs/probe stop (and the headline
+# JSON still prints) once exceeded — a slow tunnel must not starve the
+# driver of the one JSON line it records.
+DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S", "3600"))
+_T0 = time.monotonic()
+
+
+def _time_left() -> float:
+    return DEADLINE_S - (time.monotonic() - _T0)
 
 
 def _peak_flops(device) -> float | None:
@@ -296,7 +305,7 @@ def _try_rung(name, platform, image_size, num_layers, num_filters,
     return result, err
 
 
-def _max_trainable_px(start: int = 2048, cap: int = 16384,
+def _max_trainable_px(start: int = 2048, cap: int = 8192,
                       known_fit: int = 0) -> tuple[int, dict]:
     """Largest square resolution whose bs1 step completes on the chip.
 
@@ -309,7 +318,11 @@ def _max_trainable_px(start: int = 2048, cap: int = 16384,
     attempts = {}
 
     def fits(px: int) -> bool:
-        result, err = _run_sub(["--probe", str(px)], PROBE_TIMEOUT_S)
+        budget = min(PROBE_TIMEOUT_S, max(0, _time_left()))
+        if budget < 120:
+            attempts[str(px)] = {"ok": False, "error": "bench deadline reached"}
+            return False
+        result, err = _run_sub(["--probe", str(px)], budget)
         ok = bool(result and result.get("ok"))
         attempts[str(px)] = (
             {"ok": True, "first_step_s": result.get("first_step_s")} if ok
@@ -365,12 +378,17 @@ def main() -> int:
         return 0
 
     on_tpu = headline.get("platform") != "cpu"
-    skip_extra = os.environ.get("BENCH_SKIP_MEMORY_RUNGS") == "1"
+    skip_extra = (
+        os.environ.get("BENCH_SKIP_MEMORY_RUNGS") == "1" or _time_left() < 300
+    )
     if on_tpu and not skip_extra:
         # Memory-capability rung: the reference's OOM frontier (2048², bs1 —
         # its GPUs OOM at bs2 across all schemes, BASELINE.md).
         print("[bench] 2048px memory rung", file=sys.stderr)
-        r2048, err = _try_rung("tpu_2048", "tpu", 2048, 18, 416, 1, 4, 1800, False)
+        r2048, err = _try_rung(
+            "tpu_2048", "tpu", 2048, 18, 416, 1, 4,
+            min(1800, max(300, _time_left() - 300)), False,
+        )
         if r2048 is not None:
             headline["rungs"] = {"2048": {
                 "img_per_sec": r2048["value"],
