@@ -1,0 +1,69 @@
+"""The L6 entry-point layer (benchmarks/common.run) driven in-process.
+
+The engines have exact-match tests; this protects the runner glue — flag
+parsing, level/junction derivation, mesh self-provisioning, dataset
+dispatch, the epoch loop, and the summary contract — for the composite
+families (smallest configs that still exercise the full path)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.common import run
+
+
+def _argv(**over):
+    base = {
+        "--model": "resnet",
+        "--image-size": "32",
+        "--num-layers": "1",
+        "--batch-size": "8",
+        "--steps-per-epoch": "2",
+    }
+    base.update(over)
+    out = []
+    for k, v in base.items():
+        out.append(k)
+        if v is not None:
+            out.append(v)
+    return out
+
+
+def _check(summary):
+    assert set(summary) >= {"images_per_sec", "loss", "steps"}
+    assert np.isfinite(summary["loss"]), summary
+    assert summary["steps"] >= 1
+
+
+def test_run_sp_multilevel_local_dp(devices8):
+    """The most composite SP path: two spatial levels + LOCAL_DP_LP junction
+    + pipeline tail, straight through the CLI glue."""
+    _check(run("sp", "resnet", _argv(**{
+        "--batch-size": "12",
+        "--slice-method": "vertical",
+        "--num-spatial-parts": "2,1",
+        "--spatial-size": "2",
+        "--split-size": "3",
+        "--parts": "2",
+        "--local-DP": "2",
+    })))
+
+
+def test_run_gems_sp(devices8):
+    _check(run("gems_sp", "resnet", _argv(**{
+        "--split-size": "2",
+        "--parts": "2",
+        "--num-spatial-parts": "4",
+    })))
+
+
+def test_run_lp_bf16_all(devices8):
+    _check(run("lp", "resnet", _argv(**{
+        "--split-size": "2",
+        "--parts": "2",
+        "--precision": "bf_16_all",
+    })))
