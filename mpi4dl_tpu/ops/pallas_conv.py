@@ -46,50 +46,66 @@ def _kernel(x_any, w_any, o_ref, xwin, wbuf, acc, sem, wsem,
     Cin is chunked in-kernel (`n_ci` static chunks of `tcin`): per chunk the
     input window and the weight slab are DMA'd from HBM and the kh*kw shifted
     matmuls accumulate into fp32 scratch — VMEM stays bounded for any depth.
-    With a single chunk the window DMA is guarded on the first Cout tile:
-    scratch persists across the (innermost) Cout grid dimension, so the same
-    window serves every Cout tile instead of being re-read from HBM.
+    Chunks are DOUBLE-BUFFERED (two scratch slots; chunk ci+1's copies start
+    before chunk ci's matmuls) so DMA overlaps compute.  With a single chunk
+    the window DMA is instead guarded on the first Cout tile: scratch
+    persists across the (innermost) Cout grid dimension, so the same window
+    serves every Cout tile without re-reading HBM.
     """
     i = pl.program_id(0)
     j = pl.program_id(1)
     c = pl.program_id(2)
 
-    def win_copy(ci):
+    def win_copy(ci, slot):
         return pltpu.make_async_copy(
             x_any.at[
                 pl.ds(i * th, th + kh - 1),
                 pl.ds(j * tw, tw + kw - 1),
                 pl.ds(ci * tcin, tcin),
             ],
-            xwin,
-            sem,
+            xwin.at[slot],
+            sem.at[slot],
         )
 
-    acc[:] = jnp.zeros_like(acc)
-    for ci in range(n_ci):
-        w_dma = pltpu.make_async_copy(
+    def w_copy(ci, slot):
+        return pltpu.make_async_copy(
             w_any.at[:, :, pl.ds(ci * tcin, tcin), pl.ds(c * tco, tco)],
-            wbuf,
-            wsem,
+            wbuf.at[slot],
+            wsem.at[slot],
         )
-        w_dma.start()
-        if n_ci == 1:
-            @pl.when(c == 0)
-            def _():
-                dma = win_copy(0)
-                dma.start()
-                dma.wait()
-        else:
-            dma = win_copy(ci)
-            dma.start()
-            dma.wait()
-        w_dma.wait()
+
+    def accumulate(slot):
         for dy in range(kh):
             for dx in range(kw):
-                xs = xwin[dy : dy + th, dx : dx + tw, :].reshape(th * tw, tcin)
-                acc[:] += jnp.dot(
-                    xs, wbuf[dy, dx], preferred_element_type=jnp.float32
+                xs = xwin[slot, dy : dy + th, dx : dx + tw, :].reshape(
+                    th * tw, tcin
                 )
+                acc[:] += jnp.dot(
+                    xs, wbuf[slot, dy, dx], preferred_element_type=jnp.float32
+                )
+
+    acc[:] = jnp.zeros_like(acc)
+    if n_ci == 1:
+        w_copy(0, 0).start()
+
+        @pl.when(c == 0)
+        def _():
+            win_copy(0, 0).start()
+            win_copy(0, 0).wait()
+
+        w_copy(0, 0).wait()
+        accumulate(0)
+    else:
+        win_copy(0, 0).start()
+        w_copy(0, 0).start()
+        for ci in range(n_ci):
+            slot = ci % 2
+            if ci + 1 < n_ci:
+                win_copy(ci + 1, 1 - slot).start()
+                w_copy(ci + 1, 1 - slot).start()
+            win_copy(ci, slot).wait()
+            w_copy(ci, slot).wait()
+            accumulate(slot)
     o_ref[:] = acc[:].reshape(th, tw, tco).astype(o_ref.dtype)
 
 
@@ -131,10 +147,17 @@ def halo_conv2d(
     cin_p = _round_up(cin, 128)
     if tcin is None:
         win_rows = (th + kh - 1) * (tw + kw - 1) * x.dtype.itemsize
-        tcin = max(128, min(cin_p, (_WINDOW_BUDGET // win_rows) // 128 * 128))
+        fit = (_WINDOW_BUDGET // win_rows) // 128 * 128
+        if fit >= cin_p:
+            tcin = cin_p  # single chunk, single scratch slot
+        else:
+            # Chunked path double-buffers: each of the 2 slots gets half the
+            # window budget (floor one 128 lane-group).
+            tcin = max(128, (fit // 2) // 128 * 128)
     assert tcin % 128 == 0, tcin
     cin_p = _round_up(cin_p, tcin)
     n_ci = cin_p // tcin
+    nslots = 2 if n_ci > 1 else 1
     cout_p = _round_up(cout, tco)
     h_p = _round_up(h, th)
     w_p = _round_up(wid, tw)
@@ -166,11 +189,11 @@ def halo_conv2d(
             (th, tw, tco), lambda i, j, c: (i, j, c), memory_space=pltpu.VMEM
         ),
         scratch_shapes=[
-            pltpu.VMEM((th + kh - 1, tw + kw - 1, tcin), x.dtype),
-            pltpu.VMEM((kh, kw, tcin, tco), w.dtype),
+            pltpu.VMEM((nslots, th + kh - 1, tw + kw - 1, tcin), x.dtype),
+            pltpu.VMEM((nslots, kh, kw, tcin, tco), w.dtype),
             pltpu.VMEM((th * tw, tco), jnp.float32),
-            pltpu.SemaphoreType.DMA(()),
-            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((nslots,)),
+            pltpu.SemaphoreType.DMA((nslots,)),
         ],
         interpret=interpret,
     )
