@@ -114,7 +114,10 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-filters", type=int, default=416)
     p.add_argument("--num-classes", type=int, default=10)
     p.add_argument("--balance", type=str, default=None)
-    p.add_argument("--halo-d2", action="store_true")
+    # the reference spells it --halo-D2 (parser.py); accept both
+    p.add_argument("--halo-d2", "--halo-D2", dest="halo_d2", action="store_true")
+    p.add_argument("--verbose", action="store_true",
+                   help="enable debug logging (reference parser.py --verbose)")
     p.add_argument("--fused-layers", type=int, default=0,
                    help="padded layers per fused D2 exchange; 0 = maximal")
     p.add_argument("--local-DP", dest="local_dp_lp", type=int, default=1)
@@ -151,6 +154,10 @@ def _int_tuple(s: Optional[str]) -> Optional[Tuple[int, ...]]:
 
 
 def config_from_args(args: argparse.Namespace) -> ParallelConfig:
+    if getattr(args, "verbose", False):
+        import logging
+
+        logging.basicConfig(level=logging.DEBUG)
     cfg = ParallelConfig(
         model=args.model,
         batch_size=args.batch_size,
