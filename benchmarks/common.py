@@ -299,6 +299,13 @@ def run(family: str, model: str, argv=None) -> dict:
     )
     args = parser.parse_args(argv)
     cfg = config_from_args(args)
+    if cfg.verbose:
+        # Reference --verbose enables stdlib logging (benchmark scripts,
+        # e.g. benchmark_amoebanet_sp.py:41-42); force=True because jax/absl
+        # may already have attached root handlers.
+        import logging
+
+        logging.basicConfig(level=logging.DEBUG, force=True)
     if cfg.enable_master_comm_opt:
         print(
             "note: --enable-master-comm-opt is a no-op here — the one-weight-"

@@ -50,6 +50,7 @@ class ParallelConfig:
     optimizer: str = "sgd"
     remat: bool = True  # jax.checkpoint each stage application
     pallas_conv: bool = False  # route eligible SP convs through the Pallas kernel
+    verbose: bool = False  # debug logging (reference parser.py --verbose)
     checkpoint_dir: Optional[str] = None
     seed: int = 0
 
@@ -154,10 +155,6 @@ def _int_tuple(s: Optional[str]) -> Optional[Tuple[int, ...]]:
 
 
 def config_from_args(args: argparse.Namespace) -> ParallelConfig:
-    if getattr(args, "verbose", False):
-        import logging
-
-        logging.basicConfig(level=logging.DEBUG)
     cfg = ParallelConfig(
         model=args.model,
         batch_size=args.batch_size,
@@ -188,6 +185,7 @@ def config_from_args(args: argparse.Namespace) -> ParallelConfig:
         lr=args.lr,
         remat=not args.no_remat,
         pallas_conv=args.pallas_conv,
+        verbose=getattr(args, "verbose", False),
         checkpoint_dir=args.checkpoint_dir,
         seed=args.seed,
     )
