@@ -133,15 +133,23 @@ class Conv2d(Layer):
                 (0, 0) if halo_h.lo else (ph, ph),
                 (0, 0) if halo_w.lo else (pw, pw),
             )
+            from mpi4dl_tpu.ops.pallas_conv import (
+                halo_conv2d_t, pallas_conv_eligible,
+            )
+
             if (
                 sp.use_pallas_conv
                 and (sh, sw) == (1, 1)
                 and self.feature_group_count == 1
+                and pallas_conv_eligible(
+                    kernel.shape[2], kernel.shape[3],
+                    kernel.shape[0], kernel.shape[1],
+                    itemsize=kernel.dtype.itemsize,
+                )
             ):
                 # Pallas margin-consuming kernel (ops/pallas_conv.py): wants
                 # the margin present on BOTH dims — explicitly pad any dim
                 # whose padding wasn't realized by halo exchange.
-                from mpi4dl_tpu.ops.pallas_conv import halo_conv2d_t
 
                 pads = [(0, 0), padding[0], padding[1], (0, 0)]
                 if any(p != (0, 0) for p in pads):

@@ -27,7 +27,6 @@ grid by the wrapper; the un-padded result is sliced back out.
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -40,83 +39,89 @@ def _round_up(n: int, m: int) -> int:
 
 
 def _kernel(x_any, w_any, o_ref, xwin, wbuf, acc, sem, wsem,
-            *, kh, kw, th, tw, tcin, n_ci, tco):
+            *, kh, kw, th, tw, tww, tco):
     """One (H-tile, W-tile, Cout-tile) program.
 
-    Cin is chunked in-kernel (`n_ci` static chunks of `tcin`): per chunk the
-    input window and the weight slab are DMA'd from HBM and the kh*kw shifted
-    matmuls accumulate into fp32 scratch — VMEM stays bounded for any depth.
-    Chunks are DOUBLE-BUFFERED (two scratch slots; chunk ci+1's copies start
-    before chunk ci's matmuls) so DMA overlaps compute.  With a single chunk
-    the window DMA is instead guarded on the first Cout tile: scratch
-    persists across the (innermost) Cout grid dimension, so the same window
-    serves every Cout tile without re-reading HBM.
+    The input window carries the FULL Cin depth — deep layers shrink the H
+    tile (wrapper) instead of chunking Cin in-kernel.  An earlier revision
+    chunked Cin through slot-reused DMA scratch; hardware runs showed that
+    races: Mosaic does not fence a DMA write into VMEM against in-flight
+    vector/MXU reads of the same buffer, so the chunk DMA landed while the
+    previous chunk's matmuls were still reading (WAR hazard — wrong sums at
+    n_ci >= 3, verified against a pure-DMA addressing probe that was exact).
+    Keeping Cin whole means every scratch buffer is written by exactly one
+    DMA per (i, j) visit, waited before first read — no reuse, no race.
+
+    The window DMA is guarded on the first Cout tile: scratch persists
+    across the (innermost) Cout grid dimension, so the same window serves
+    every Cout tile without re-reading HBM.
     """
     i = pl.program_id(0)
     j = pl.program_id(1)
     c = pl.program_id(2)
 
-    def win_copy(ci, slot):
-        return pltpu.make_async_copy(
-            x_any.at[
-                pl.ds(i * th, th + kh - 1),
-                pl.ds(j * tw, tw + kw - 1),
-                pl.ds(ci * tcin, tcin),
-            ],
-            xwin.at[slot],
-            sem.at[slot],
-        )
+    # Mosaic requires HBM slice extents on the sublane dim (W here) to be
+    # multiples of the 8-row tiling — `tww` is tw+kw-1 rounded up to 8
+    # (the wrapper pads the input so the over-read stays in bounds).
+    win_copy = pltpu.make_async_copy(
+        x_any.at[pl.ds(i * th, th + kh - 1), pl.ds(j * tw, tww), :],
+        xwin,
+        sem,
+    )
+    w_copy = pltpu.make_async_copy(
+        w_any.at[:, :, :, pl.ds(c * tco, tco)],
+        wbuf,
+        wsem,
+    )
 
-    def w_copy(ci, slot):
-        return pltpu.make_async_copy(
-            w_any.at[:, :, pl.ds(ci * tcin, tcin), pl.ds(c * tco, tco)],
-            wbuf.at[slot],
-            wsem.at[slot],
-        )
+    w_copy.start()
 
-    def accumulate(slot):
-        for dy in range(kh):
-            for dx in range(kw):
-                xs = xwin[slot, dy : dy + th, dx : dx + tw, :].reshape(
-                    th * tw, tcin
-                )
-                acc[:] += jnp.dot(
-                    xs, wbuf[slot, dy, dx], preferred_element_type=jnp.float32
-                )
+    @pl.when(c == 0)
+    def _():
+        win_copy.start()
+        win_copy.wait()
 
+    w_copy.wait()
     acc[:] = jnp.zeros_like(acc)
-    if n_ci == 1:
-        w_copy(0, 0).start()
-
-        @pl.when(c == 0)
-        def _():
-            win_copy(0, 0).start()
-            win_copy(0, 0).wait()
-
-        w_copy(0, 0).wait()
-        accumulate(0)
-    else:
-        win_copy(0, 0).start()
-        w_copy(0, 0).start()
-        for ci in range(n_ci):
-            slot = ci % 2
-            if ci + 1 < n_ci:
-                win_copy(ci + 1, 1 - slot).start()
-                w_copy(ci + 1, 1 - slot).start()
-            win_copy(ci, slot).wait()
-            w_copy(ci, slot).wait()
-            accumulate(slot)
+    for dy in range(kh):
+        for dx in range(kw):
+            xs = xwin[dy : dy + th, dx : dx + tw, :].reshape(th * tw, -1)
+            acc[:] += jnp.dot(
+                xs, wbuf[dy, dx], preferred_element_type=jnp.float32
+            )
     o_ref[:] = acc[:].reshape(th, tw, tco).astype(o_ref.dtype)
 
 
-# Per-program VMEM budget for the input-window scratch (bytes); the window
-# shrinks its Cin chunk until it fits, so deep layers (cin 1024-2048) run
+# Per-program VMEM budget for the input-window scratch (bytes); the H tile
+# halves until the full-Cin window fits, so deep layers (cin 1024-2048) run
 # instead of dying in an opaque Mosaic allocation error.
 _WINDOW_BUDGET = 6 * 1024 * 1024
+# Cap on the per-Cout-tile weight slab [kh, kw, Cin, tco] — beyond this the
+# kernel would not fit VMEM alongside the window; callers should fall back
+# to XLA's conv (Conv2d's dispatch checks pallas_conv_eligible).
+_WSLAB_CAP = 8 * 1024 * 1024
+
+
+def _wslab_bytes(c: int, kh: int, kw: int, tco: int, itemsize: int) -> int:
+    return kh * kw * _round_up(c, 128) * tco * itemsize
+
+
+def pallas_conv_eligible(cin: int, cout: int | None = None, kh: int = 3,
+                         kw: int = 3, tco: int = 128,
+                         itemsize: int = 2) -> bool:
+    """True when the weight slab [kh, kw, Cin, tco] fits the VMEM cap — the
+    dispatch-time check mirroring the wrapper's trace-time error.  When
+    ``cout`` is given, the backward dx conv's io-swapped slab
+    [kh, kw, Cout, tco] must fit too (``_bwd`` runs the same kernel with
+    Cin/Cout exchanged)."""
+    ok = _wslab_bytes(cin, kh, kw, tco, itemsize) <= _WSLAB_CAP
+    if cout is not None:
+        ok = ok and _wslab_bytes(cout, kh, kw, tco, itemsize) <= _WSLAB_CAP
+    return ok
 
 
 @functools.partial(
-    jax.jit, static_argnames=("th", "tw", "tco", "tcin", "interpret", "out_dtype")
+    jax.jit, static_argnames=("th", "tw", "tco", "interpret", "out_dtype")
 )
 def halo_conv2d(
     x: jax.Array,
@@ -124,7 +129,6 @@ def halo_conv2d(
     th: int = 64,
     tw: int = 128,
     tco: int = 128,
-    tcin: Optional[int] = None,
     out_dtype=None,
     interpret: bool = False,
 ) -> jax.Array:
@@ -134,8 +138,9 @@ def halo_conv2d(
        under SP, or ``jnp.pad`` for the single-device case);
     w: [kh, kw, Cin, Cout].  Returns [N, H, W, Cout].
 
-    ``tcin``: Cin chunk per in-kernel DMA (default: largest 128-multiple
-    whose window fits the VMEM budget).
+    ``th`` is an upper bound: it halves until the full-Cin input window fits
+    the VMEM budget (Cin is never chunked — see the WAR-hazard note on
+    ``_kernel``).
     """
     n, hp, wp, cin = x.shape
     kh, kw, wcin, cout = w.shape
@@ -145,24 +150,30 @@ def halo_conv2d(
     out_dtype = out_dtype or x.dtype
 
     cin_p = _round_up(cin, 128)
-    if tcin is None:
-        win_rows = (th + kh - 1) * (tw + kw - 1) * x.dtype.itemsize
-        fit = (_WINDOW_BUDGET // win_rows) // 128 * 128
-        if fit >= cin_p:
-            tcin = cin_p  # single chunk, single scratch slot
-        else:
-            # Chunked path double-buffers: each of the 2 slots gets half the
-            # window budget (floor one 128 lane-group).
-            tcin = max(128, (fit // 2) // 128 * 128)
-    assert tcin % 128 == 0, tcin
-    cin_p = _round_up(cin_p, tcin)
-    n_ci = cin_p // tcin
-    nslots = 2 if n_ci > 1 else 1
+    wslab = kh * kw * cin_p * tco * w.dtype.itemsize
+    if wslab > _WSLAB_CAP:
+        raise ValueError(
+            f"pallas halo_conv2d: weight slab {wslab} B for cin={cin} "
+            f"kh*kw={kh * kw} exceeds the VMEM cap {_WSLAB_CAP} B — use "
+            f"lax.conv for this layer (pallas_conv_eligible gates dispatch)"
+        )
+    win_bytes = (
+        lambda t: (t + kh - 1) * _round_up(tw + kw - 1, 8) * cin_p
+        * x.dtype.itemsize
+    )
+    while th > 1 and win_bytes(th) > _WINDOW_BUDGET:
+        th //= 2
     cout_p = _round_up(cout, tco)
     h_p = _round_up(h, th)
     w_p = _round_up(wid, tw)
+    # DMA window width rounded to the 8-row sublane tiling (Mosaic slice
+    # alignment); the input's W is padded so the last tile's over-read of
+    # (tww - tw - (kw-1)) columns stays in bounds.
+    tww = _round_up(tw + kw - 1, 8)
     x_p = jnp.pad(
-        x, ((0, 0), (0, h_p - h), (0, w_p - wid), (0, cin_p - cin))
+        x,
+        ((0, 0), (0, h_p - h), (0, w_p + tww - tw - (kw - 1) - wid),
+         (0, cin_p - cin)),
     )
     w_pd = jnp.pad(w, ((0, 0), (0, 0), (0, cin_p - cin), (0, cout_p - cout)))
 
@@ -176,8 +187,7 @@ def halo_conv2d(
         out_struct = jax.ShapeDtypeStruct((h_p, w_p, cout_p), out_dtype)
     call = pl.pallas_call(
         functools.partial(
-            _kernel, kh=kh, kw=kw, th=th, tw=tw,
-            tcin=tcin, n_ci=n_ci, tco=tco,
+            _kernel, kh=kh, kw=kw, th=th, tw=tw, tww=tww, tco=tco,
         ),
         out_shape=out_struct,
         grid=grid,
@@ -189,11 +199,11 @@ def halo_conv2d(
             (th, tw, tco), lambda i, j, c: (i, j, c), memory_space=pltpu.VMEM
         ),
         scratch_shapes=[
-            pltpu.VMEM((nslots, th + kh - 1, tw + kw - 1, tcin), x.dtype),
-            pltpu.VMEM((nslots, kh, kw, tcin, tco), w.dtype),
+            pltpu.VMEM((th + kh - 1, tww, cin_p), x.dtype),
+            pltpu.VMEM((kh, kw, cin_p, tco), w.dtype),
             pltpu.VMEM((th * tw, tco), jnp.float32),
-            pltpu.SemaphoreType.DMA((nslots,)),
-            pltpu.SemaphoreType.DMA((nslots,)),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
         ],
         interpret=interpret,
     )
@@ -246,10 +256,17 @@ def _bwd(interpret, res, ct):
     # its output is exactly x's (padded-input) shape.
     ct_pad = jnp.pad(ct, ((0, 0), (kh - 1, kh - 1), (kw - 1, kw - 1), (0, 0)))
     w_t = jnp.flip(w, axis=(0, 1)).swapaxes(2, 3)
-    dx = halo_conv2d(
-        ct_pad, w_t.astype(ct.dtype), out_dtype=x.dtype,
-        interpret=_auto_interpret(interpret),
-    )
+    if _wslab_bytes(w_t.shape[2], kh, kw, 128,
+                    ct.dtype.itemsize) <= _WSLAB_CAP:
+        dx = halo_conv2d(
+            ct_pad, w_t.astype(ct.dtype), out_dtype=x.dtype,
+            interpret=_auto_interpret(interpret),
+        )
+    else:
+        # Swapped slab (Cin' = forward Cout) too big for VMEM: same math on
+        # XLA's conv.  Reached only when halo_conv2d_t is called directly —
+        # Conv2d's dispatch gate bounds both directions.
+        dx = _lax_valid_conv(ct_pad, w_t.astype(ct.dtype)).astype(x.dtype)
     # dw: XLA's backprop-filter.  linear_transpose (the conv is linear in w)
     # avoids jax.vjp's throwaway primal forward on eager backward calls.
     w_t_fn = jax.linear_transpose(lambda w_: _lax_valid_conv(x, w_), w)

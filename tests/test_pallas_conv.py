@@ -38,14 +38,59 @@ def test_halo_conv2d_matches_lax(kh, kw, cin, cout, h, w, th, tw):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4)
 
 
-def test_halo_conv2d_cin_chunked():
-    """Deep-layer path: cin above the chunk size runs the in-kernel Cin loop
-    (n_ci > 1) with per-chunk window/weight DMA."""
+def test_halo_conv2d_deep_cin_shrinks_h_tile():
+    """Deep-layer path: Cin stays whole (never chunked — WAR-hazard note in
+    ops/pallas_conv.py) and the H tile halves until the window fits VMEM;
+    with th forced large the wrapper must still produce exact results."""
     x = jax.random.normal(jax.random.key(3), (1, 18, 34, 300), jnp.float32)
     wk = jax.random.normal(jax.random.key(4), (3, 3, 300, 64), jnp.float32) / 9
-    got = halo_conv2d(x, wk, th=16, tw=32, tco=64, tcin=128, interpret=True)
+    got = halo_conv2d(x, wk, th=16, tw=32, tco=64, interpret=True)
     want = _ref_conv(x, wk)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4)
+
+
+def test_halo_conv2d_wslab_cap_raises():
+    """Past the VMEM weight-slab cap the wrapper refuses loudly (dispatch
+    pre-checks pallas_conv_eligible and keeps such layers on lax.conv)."""
+    from mpi4dl_tpu.ops.pallas_conv import pallas_conv_eligible
+
+    assert pallas_conv_eligible(512)
+    assert not pallas_conv_eligible(8192)
+    # Eligibility scales with kernel size (a 5x5 slab is 25/9 the 3x3's)
+    # and must bound the BACKWARD dx conv too (Cin' = forward Cout).
+    assert pallas_conv_eligible(1536, kh=3, kw=3)
+    assert not pallas_conv_eligible(1536, kh=5, kw=5)
+    assert not pallas_conv_eligible(256, cout=8192)
+    x = jnp.zeros((1, 6, 6, 8192), jnp.bfloat16)
+    wk = jnp.zeros((3, 3, 8192, 64), jnp.bfloat16)
+    with pytest.raises(ValueError, match="weight slab"):
+        halo_conv2d(x, wk, tco=64, interpret=True)
+
+
+def test_halo_conv2d_t_bwd_falls_back_past_cap(monkeypatch):
+    """A forward-eligible conv whose io-swapped backward slab exceeds the
+    VMEM cap must take the lax fallback in _bwd, not raise mid-training."""
+    from mpi4dl_tpu.ops import pallas_conv as pc
+
+    k1, k2, k3 = jax.random.split(jax.random.key(7), 3)
+    x = jax.random.normal(k1, (1, 10, 12, 8), jnp.float32)
+    w = jax.random.normal(k2, (3, 3, 8, 150), jnp.float32) / 9
+    t = jax.random.normal(k3, (1, 8, 10, 150), jnp.float32)
+    # Shrink the cap so cin=8 (slab for 128 lanes) stays eligible but the
+    # swapped cin'=150 (rounds to 256) is not.
+    monkeypatch.setattr(
+        pc, "_WSLAB_CAP", pc._wslab_bytes(8, 3, 3, 128, 4)
+    )
+
+    gx, gw = jax.grad(
+        lambda x, w: jnp.sum(pc.halo_conv2d_t(x, w, True) * t),
+        argnums=(0, 1),
+    )(x, w)
+    gx_l, gw_l = jax.grad(
+        lambda x, w: jnp.sum(_ref_conv(x, w) * t), argnums=(0, 1)
+    )(x, w)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(gx_l), atol=2e-4)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(gw_l), atol=2e-3)
 
 
 def test_halo_conv2d_batch_and_dtype():
