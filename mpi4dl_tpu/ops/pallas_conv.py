@@ -100,6 +100,9 @@ _WINDOW_BUDGET = 6 * 1024 * 1024
 # kernel would not fit VMEM alongside the window; callers should fall back
 # to XLA's conv (Conv2d's dispatch checks pallas_conv_eligible).
 _WSLAB_CAP = 8 * 1024 * 1024
+# Default Cout tile — shared by halo_conv2d, the eligibility gate, and
+# _bwd's fallback check so their slab math cannot drift apart.
+_DEFAULT_TCO = 128
 
 
 def _wslab_bytes(c: int, kh: int, kw: int, tco: int, itemsize: int) -> int:
@@ -107,7 +110,7 @@ def _wslab_bytes(c: int, kh: int, kw: int, tco: int, itemsize: int) -> int:
 
 
 def pallas_conv_eligible(cin: int, cout: int | None = None, kh: int = 3,
-                         kw: int = 3, tco: int = 128,
+                         kw: int = 3, tco: int = _DEFAULT_TCO,
                          itemsize: int = 2) -> bool:
     """True when the weight slab [kh, kw, Cin, tco] fits the VMEM cap — the
     dispatch-time check mirroring the wrapper's trace-time error.  When
@@ -128,7 +131,7 @@ def halo_conv2d(
     w: jax.Array,
     th: int = 64,
     tw: int = 128,
-    tco: int = 128,
+    tco: int = _DEFAULT_TCO,
     out_dtype=None,
     interpret: bool = False,
 ) -> jax.Array:
@@ -150,7 +153,7 @@ def halo_conv2d(
     out_dtype = out_dtype or x.dtype
 
     cin_p = _round_up(cin, 128)
-    wslab = kh * kw * cin_p * tco * w.dtype.itemsize
+    wslab = _wslab_bytes(cin, kh, kw, tco, w.dtype.itemsize)
     if wslab > _WSLAB_CAP:
         raise ValueError(
             f"pallas halo_conv2d: weight slab {wslab} B for cin={cin} "
@@ -256,7 +259,7 @@ def _bwd(interpret, res, ct):
     # its output is exactly x's (padded-input) shape.
     ct_pad = jnp.pad(ct, ((0, 0), (kh - 1, kh - 1), (kw - 1, kw - 1), (0, 0)))
     w_t = jnp.flip(w, axis=(0, 1)).swapaxes(2, 3)
-    if _wslab_bytes(w_t.shape[2], kh, kw, 128,
+    if _wslab_bytes(w_t.shape[2], kh, kw, _DEFAULT_TCO,
                     ct.dtype.itemsize) <= _WSLAB_CAP:
         dx = halo_conv2d(
             ct_pad, w_t.astype(ct.dtype), out_dtype=x.dtype,

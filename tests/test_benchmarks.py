@@ -67,3 +67,26 @@ def test_run_lp_bf16_all(devices8):
         "--parts": "2",
         "--precision": "bf_16_all",
     })))
+
+
+def test_pallas_conv_flag_tristate():
+    """--pallas-conv / --no-pallas-conv / absent parse to True/False/None,
+    and auto resolves OFF on the CPU backend (the kernel is a Mosaic
+    program; TPU backends resolve ON — PERF_NOTES.md decision)."""
+    from mpi4dl_tpu.config import (
+        config_from_args, get_parser, resolve_pallas_conv,
+    )
+
+    p = get_parser()
+    assert config_from_args(p.parse_args([])).pallas_conv is None
+    assert config_from_args(p.parse_args(["--pallas-conv"])).pallas_conv is True
+    assert config_from_args(
+        p.parse_args(["--no-pallas-conv"])
+    ).pallas_conv is False
+    assert resolve_pallas_conv(True) is True
+    assert resolve_pallas_conv(False) is False
+    import jax
+
+    assert resolve_pallas_conv(None) is (
+        jax.default_backend() in ("tpu", "axon")
+    )

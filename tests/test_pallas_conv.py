@@ -38,13 +38,29 @@ def test_halo_conv2d_matches_lax(kh, kw, cin, cout, h, w, th, tw):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4)
 
 
-def test_halo_conv2d_deep_cin_shrinks_h_tile():
+def test_halo_conv2d_deep_cin_full_depth():
     """Deep-layer path: Cin stays whole (never chunked — WAR-hazard note in
-    ops/pallas_conv.py) and the H tile halves until the window fits VMEM;
-    with th forced large the wrapper must still produce exact results."""
+    ops/pallas_conv.py); cin past one lane group must still be exact."""
     x = jax.random.normal(jax.random.key(3), (1, 18, 34, 300), jnp.float32)
     wk = jax.random.normal(jax.random.key(4), (3, 3, 300, 64), jnp.float32) / 9
     got = halo_conv2d(x, wk, th=16, tw=32, tco=64, interpret=True)
+    want = _ref_conv(x, wk)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4)
+
+
+def test_halo_conv2d_h_tile_shrinks_to_fit(monkeypatch):
+    """The replacement for Cin chunking: when the full-Cin window exceeds
+    the VMEM budget the H tile halves until it fits.  A tiny budget forces
+    th 16 -> 2 (win_bytes(2) = 4*40*128*4 = 80 KiB under a 100 KiB budget),
+    exercising the shrunken-grid path end to end."""
+    from mpi4dl_tpu.ops import pallas_conv as pc
+
+    monkeypatch.setattr(pc, "_WINDOW_BUDGET", 100 * 1024)
+    x = jax.random.normal(jax.random.key(8), (1, 20, 34, 24), jnp.float32)
+    wk = jax.random.normal(jax.random.key(9), (3, 3, 24, 32), jnp.float32) / 9
+    # jit caches by static args only — different th avoids a stale entry
+    # traced under the default budget.
+    got = pc.halo_conv2d(x, wk, th=16, tw=32, tco=32, interpret=True)
     want = _ref_conv(x, wk)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4)
 
