@@ -1,0 +1,157 @@
+"""Long-context attention microbenchmark — the 1-D (sequence) twin of the
+halo tools (SURVEY §5 "long-context analog").
+
+Times exact attention three ways at a given sequence length:
+
+  einsum      — the materialized-scores reference (ops/ring.py einsum path)
+  flash       — the Pallas blockwise kernel (ops/pallas_attention.py)
+  ring        — ring_attention over an n-device mesh (CPU: validates the
+                sharded schedule; real multi-chip: measures the ICI overlap)
+
+and exact-validates flash and ring against the reference.  Beyond the
+einsum path's memory wall (T² scores: 34 GB at T=32k, H=8) only flash
+runs — pass --flash-only.
+
+Examples:
+  python benchmark_ring_attention.py --seq-len 8192 --heads 8 --dim 128
+  python benchmark_ring_attention.py --seq-len 32768 --flash-only
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \\
+      python benchmark_ring_attention.py --seq-len 1024 --ring-devices 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0,
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))),
+)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq-len", type=int, default=4096)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--dim", type=int, default=128)
+    p.add_argument("--batch", type=int, default=1)
+    p.add_argument("--causal", action="store_true", default=True)
+    p.add_argument("--no-causal", dest="causal", action="store_false")
+    p.add_argument("--dtype", default="bf16", choices=["bf16", "f32"])
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--iterations", type=int, default=20)
+    p.add_argument("--flash-only", action="store_true",
+                   help="skip the einsum reference (OOM territory)")
+    p.add_argument("--ring-devices", type=int, default=0,
+                   help="also run ring_attention over this many devices "
+                        "(0 = skip; needs that many JAX devices)")
+    p.add_argument("--interpret", action="store_true",
+                   help="Pallas interpreter mode (CPU)")
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi4dl_tpu.ops.pallas_attention import flash_attention_local
+    from mpi4dl_tpu.ops.ring import ring_attention
+
+    dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
+    b, t, h, d = args.batch, args.seq_len, args.heads, args.dim
+    ks = jax.random.split(jax.random.key(0), 3)
+    q, k, v = (jax.random.normal(kk, (b, t, h, d), dtype) for kk in ks)
+    # attention flops: QK^T + PV, 2 matmuls x 2 flops/MAC
+    flops = 4 * b * h * t * t * d
+    if args.causal:
+        flops //= 2
+
+    def timed(fn, *xs):
+        out = fn(*xs)
+        float(jnp.sum(out[..., 0].astype(jnp.float32)))  # honest D2H sync
+        for _ in range(args.warmup):
+            out = fn(*xs)
+        float(jnp.sum(out[..., 0].astype(jnp.float32)))
+        t0 = time.perf_counter()
+        for _ in range(args.iterations):
+            out = fn(*xs)
+        float(jnp.sum(out[..., 0].astype(jnp.float32)))
+        dt = (time.perf_counter() - t0) / args.iterations
+        return out, dt
+
+    variants = {}
+    flash = jax.jit(
+        lambda q, k, v: flash_attention_local(
+            q, k, v, causal=args.causal, interpret=args.interpret
+        )
+    )
+    out_f, dt = timed(flash, q, k, v)
+    variants["flash"] = {"ms": round(dt * 1e3, 3),
+                         "tflops": round(flops / dt / 1e12, 2)}
+
+    validation = None
+    if not args.flash_only:
+        ref = jax.jit(
+            lambda q, k, v: ring_attention(
+                q, k, v, None, 1, causal=args.causal, use_flash=False
+            )
+        )
+        out_r, dt = timed(ref, q, k, v)
+        variants["einsum"] = {"ms": round(dt * 1e3, 3),
+                              "tflops": round(flops / dt / 1e12, 2)}
+        validation = bool(np.allclose(
+            np.asarray(out_f, np.float32), np.asarray(out_r, np.float32),
+            rtol=0.05, atol=0.05,
+        ))
+
+    if args.ring_devices > 1:
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from mpi4dl_tpu.mesh import MeshSpec, build_mesh
+
+        n = args.ring_devices
+        mesh = build_mesh(MeshSpec(spw=n), jax.devices()[:n])
+        spec = P(None, "spw", None, None)
+        ring = jax.jit(
+            shard_map(
+                lambda a, bb, c: ring_attention(
+                    a, bb, c, "spw", n, causal=args.causal,
+                    interpret=args.interpret,
+                ),
+                mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            )
+        )
+        out_ring, dt = timed(ring, q, k, v)
+        variants["ring"] = {"ms": round(dt * 1e3, 3),
+                            "tflops": round(flops / dt / 1e12, 2),
+                            "devices": n}
+        if not args.flash_only:
+            validation = validation and bool(np.allclose(
+                np.asarray(out_ring, np.float32),
+                np.asarray(out_r, np.float32), rtol=0.05, atol=0.05,
+            ))
+
+    out = {
+        "metric": "exact_attention_ms",
+        "value": variants["flash"]["ms"],
+        "unit": "ms",
+        "config": {"seq_len": t, "heads": h, "dim": d, "batch": b,
+                   "causal": args.causal, "dtype": args.dtype},
+        "variants": variants,
+        "flops_per_call": flops,
+        "validation": (
+            "skipped" if validation is None
+            else ("pass" if validation else "FAIL")
+        ),
+        "platform": jax.devices()[0].platform,
+    }
+    print(json.dumps(out))
+    return 0 if validation in (None, True) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -103,6 +103,15 @@ class ParallelConfig:
             assert len(self.balance) == self.split_size
 
 
+def is_tpu_backend() -> bool:
+    """True on TPU backends (incl. the experimental axon plugin) — the
+    shared auto-enable predicate for Pallas (Mosaic) kernels: the conv
+    dispatch here and ring attention's flash path (ops/ring.py)."""
+    import jax
+
+    return jax.default_backend() in ("tpu", "axon")
+
+
 def resolve_pallas_conv(setting: Optional[bool]) -> bool:
     """Resolve the tri-state ``pallas_conv`` config: ``None`` = auto — the
     kernel is a Mosaic (TPU) program, so auto enables it only on TPU
@@ -110,9 +119,7 @@ def resolve_pallas_conv(setting: Optional[bool]) -> bool:
     PERF_NOTES.md); CPU/GPU keep XLA conv (interpret mode is for tests)."""
     if setting is not None:
         return setting
-    import jax
-
-    return jax.default_backend() in ("tpu", "axon")
+    return is_tpu_backend()
 
 
 def get_parser() -> argparse.ArgumentParser:

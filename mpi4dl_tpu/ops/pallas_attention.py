@@ -1,0 +1,345 @@
+"""Pallas blockwise (flash) attention for the long-context path.
+
+The 1-D sequence-parallel module (ops/ring.py) is exact ring attention:
+K/V blocks circulate the ICI ring and each device folds blocks into its
+queries' output with an online softmax.  Its local block compute, written
+as einsums, materializes the [B, H, Tq, Tk] score tensor between the two
+matmuls — O(T_local²) HBM traffic per hop, which becomes the long-context
+ceiling (134 MB fp32 at T_local = 2048, B=1, H=8).  This module fuses that
+block compute into a Pallas kernel in the flash-attention style: scores
+live only as a [TQ, TK] VMEM tile between the QKᵀ and P·V matmuls.
+
+Design (deliberately different from a monolithic flash attention):
+
+- :func:`block_flash` returns the block's UNNORMALIZED partial state
+  ``(o_hat, m, l)`` — the flash m/l/o triple — instead of a normalized
+  output, because ring attention must keep folding further K/V blocks in.
+- :func:`mlo_merge` is the associative combine of two partial states; the
+  ring body merges each hop's block state into the running state (the same
+  update ops/ring.py applies inline today, so results are bit-comparable).
+- normalization (o / l) happens once, after the last block.
+
+The kernel pipelines via BlockSpec index maps only (no manual DMA): grid =
+(B·H, Tq tiles, Tk tiles), with the Tk dimension innermost so the fp32
+accumulator scratch persists across it (zeroed at k==0, emitted at the
+last k tile).  Causal masking is by GLOBAL token position: the q/k block
+offsets arrive as scalar-prefetch arguments so one compiled kernel serves
+every ring hop (the k offset is a traced, device-varying value).
+
+Training: :func:`block_flash` carries a custom VJP whose backward is a
+``lax.scan`` of einsum tiles over the Tk dimension — memory stays
+O(TQ·TK) per step (never the full score matrix) while the matmuls stay on
+the MXU.  Reference: the flash-attention backward recurrences; residuals
+saved are (q, k, v, o_hat, m, l).
+
+Used by :func:`mpi4dl_tpu.ops.ring.ring_attention` when ``use_flash``
+resolves on (auto: TPU backends).  Interpret mode runs on CPU for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30  # large-negative instead of -inf: exp() of it is exactly 0
+                  # and max() never produces nan from (-inf) - (-inf).
+_LANES = 128
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def _out_structs(operands, shapes_dtypes):
+    """ShapeDtypeStructs carrying the operands' union vma — under shard_map
+    with vma checking, pallas_call must declare how outputs vary across mesh
+    axes (same pattern as ops/pallas_conv.py)."""
+    try:
+        vma = frozenset()
+        for op in operands:
+            vma = vma | frozenset(jax.typeof(op).vma)
+        return [
+            jax.ShapeDtypeStruct(s, d, vma=vma) for s, d in shapes_dtypes
+        ]
+    except (AttributeError, TypeError):
+        return [jax.ShapeDtypeStruct(s, d) for s, d in shapes_dtypes]
+
+
+def _kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+            acc, m_scr, l_scr, *, tq, tk, nk, causal, t_k_real):
+    """One (bh, q-tile, k-tile) step.  Scratch (acc, m, l) persists across
+    the innermost k dimension; outputs are written at the last k tile.
+    ``t_k_real``: un-padded key count (static) — key slots past it are
+    masked out so Tk padding contributes exactly nothing."""
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    q = q_ref[0].astype(jnp.float32)            # [TQ, D] (pre-scaled)
+    k = k_ref[0].astype(jnp.float32)            # [TK, D]
+    s = jax.lax.dot_general(                    # [TQ, TK]
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    col = ki * tk + lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+    if t_k_real % tk:
+        s = jnp.where(col < t_k_real, s, _NEG_INF)
+    if causal:
+        qi = pl.program_id(1)
+        q_pos = offs_ref[0] + qi * tq + lax.broadcasted_iota(
+            jnp.int32, (tq, tk), 0
+        )
+        s = jnp.where(q_pos >= offs_ref[1] + col, s, _NEG_INF)
+
+    m_prev = m_scr[:, 0]                        # [TQ]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+    c = jnp.exp(m_prev - m_new)
+    # Guard fully-masked rows: there m_new == _NEG_INF and the naive
+    # exp(s - m_new) = exp(0) = 1 would count every masked key (the classic
+    # flash pitfall — causal ring hops from later devices mask whole rows).
+    p = jnp.where(
+        s > _NEG_INF * 0.5, jnp.exp(s - m_new[:, None]), 0.0
+    )                                           # [TQ, TK]
+    l_new = l_scr[:, 0] * c + jnp.sum(p, axis=-1)
+    acc[:] = acc[:] * c[:, None] + jax.lax.dot_general(
+        p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_scr[:] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+    l_scr[:] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
+
+    @pl.when(ki == nk - 1)
+    def _():
+        o_ref[0] = acc[:].astype(o_ref.dtype)
+        m_ref[0] = m_scr[...].astype(m_ref.dtype)
+        l_ref[0] = l_scr[...].astype(l_ref.dtype)
+
+
+def _any_vma(*arrays) -> bool:
+    try:
+        return any(frozenset(jax.typeof(a).vma) for a in arrays)
+    except (AttributeError, TypeError):
+        return False
+
+
+def _block_flash_fwd_impl(q, k, v, q_off, k_off, *, causal, scale,
+                          tq, tk, interpret):
+    """Pallas forward.  q: [BH, Tq, D]; k, v: [BH, Tk_total, D] (fp32/bf16).
+    Returns (o_hat [BH, Tq, D] fp32, m [BH, Tq] fp32, l [BH, Tq] fp32)."""
+    if interpret and _any_vma(q, k, v, q_off, k_off):
+        # Interpret-mode pallas_call under shard_map trips the vma checker
+        # (its BlockSpec emulation dynamic_slices varying operands with
+        # uniform grid indices).  CPU tests of the SHARDED ring path run the
+        # einsum reference instead — identical math; the kernel itself is
+        # pinned by the uniform-context interpret tests and TPU validation.
+        return _reference_mlo(q, k, v, q_off, k_off, causal, scale)
+    bh, t_q, d = q.shape
+    _, t_k, _ = k.shape
+    tq = min(tq, _round_up(t_q, 8))
+    tk = min(tk, _round_up(t_k, 128))
+    tq_p = _round_up(t_q, tq)
+    tk_p = _round_up(t_k, tk)
+    d_p = _round_up(d, _LANES)
+    qp = jnp.pad(q.astype(jnp.float32) * scale,
+                 ((0, 0), (0, tq_p - t_q), (0, d_p - d)))
+    kp = jnp.pad(k, ((0, 0), (0, tk_p - t_k), (0, d_p - d)))
+    vp = jnp.pad(v, ((0, 0), (0, tk_p - t_k), (0, d_p - d)))
+    # Padded key slots (a q·0 = 0 score would pollute m/l) are masked inside
+    # the kernel by local column id against the static t_k.
+    nq, nk = tq_p // tq, tk_p // tk
+    offs = jnp.stack([q_off, k_off]).astype(jnp.int32)
+
+    grid = (bh, nq, nk)
+    kern = pl.pallas_call(
+        functools.partial(_kernel, tq=tq, tk=tk, nk=nk, causal=causal,
+                          t_k_real=t_k),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, tq, d_p), lambda b, i, j, offs: (b, i, 0)),
+                pl.BlockSpec((1, tk, d_p), lambda b, i, j, offs: (b, j, 0)),
+                pl.BlockSpec((1, tk, d_p), lambda b, i, j, offs: (b, j, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, tq, d_p), lambda b, i, j, offs: (b, i, 0)),
+                pl.BlockSpec((1, tq, _LANES), lambda b, i, j, offs: (b, i, 0)),
+                pl.BlockSpec((1, tq, _LANES), lambda b, i, j, offs: (b, i, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((tq, d_p), jnp.float32),
+                pltpu.VMEM((tq, _LANES), jnp.float32),
+                pltpu.VMEM((tq, _LANES), jnp.float32),
+            ],
+        ),
+        out_shape=_out_structs(
+            (qp, kp, vp, offs),
+            [
+                ((bh, tq_p, d_p), jnp.float32),
+                ((bh, tq_p, _LANES), jnp.float32),
+                ((bh, tq_p, _LANES), jnp.float32),
+            ],
+        ),
+        interpret=interpret,
+    )
+    o, m, l = kern(offs, qp, kp, vp)
+    d_out = q.shape[-1]
+    return o[:, :t_q, :d_out], m[:, :t_q, 0], l[:, :t_q, 0]
+
+
+def _reference_mlo(q, k, v, q_off, k_off, causal, scale):
+    """Einsum reference of the block partial state (for VJP + tests)."""
+    qf = q.astype(jnp.float32) * scale
+    s = jnp.einsum("bqd,bkd->bqk", qf, k.astype(jnp.float32))
+    if causal:
+        t_q, t_k = q.shape[1], k.shape[1]
+        q_pos = q_off + jnp.arange(t_q)
+        k_pos = k_off + jnp.arange(t_k)
+        s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, _NEG_INF)
+    m = jnp.max(s, axis=-1)
+    p = jnp.where(s > _NEG_INF * 0.5, jnp.exp(s - m[..., None]), 0.0)
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32))
+    return o, m, l
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9)
+)
+def block_flash(q, k, v, q_off, k_off, causal=False, scale=1.0,
+                tq=256, tk=512, interpret=False):
+    """Unnormalized flash partial state of one attention block.
+
+    q: [BH, Tq, D]; k, v: [BH, Tk, D]; ``q_off``/``k_off``: scalar GLOBAL
+    position offsets (traced values allowed — they ride scalar prefetch).
+    Returns ``(o_hat, m, l)`` with ``o_hat = exp(s - m) @ v`` and
+    ``l = rowsum(exp(s - m))``; combine across blocks with
+    :func:`mlo_merge`, finish with ``o_hat / l``.
+    """
+    return _block_flash_fwd_impl(
+        q, k, v, q_off, k_off, causal=causal, scale=scale,
+        tq=tq, tk=tk, interpret=interpret,
+    )
+
+
+def _block_flash_fwd(q, k, v, q_off, k_off, causal, scale, tq, tk, interpret):
+    o, m, l = block_flash(q, k, v, q_off, k_off, causal, scale, tq, tk,
+                          interpret)
+    return (o, m, l), (q, k, v, q_off, k_off, o, m, l)
+
+
+def _block_flash_bwd(causal, scale, tq, tk, interpret, res, cts):
+    """Blockwise backward: a scan over Tk tiles of einsum blocks — never
+    materializes the [Tq, Tk_total] score matrix.
+
+    With ô = P·V, l = rowsum(P), P = exp(s - m) (m treated as a constant
+    plateau — its cotangent is zero almost everywhere):
+        dP = dô Vᵀ + dl·1ᵀ ;  ds = P ⊙ dP
+        dq = ds K · scale ;  dk = dsᵀ Q · scale ;  dv = Pᵀ dô
+    """
+    q, k, v, q_off, k_off, o, m, l = res
+    do, dm, dl = cts  # dm is zero a.e.; fold dl into dP
+    del o, dm
+    bh, t_q, d = q.shape
+    t_k = k.shape[1]
+    qf = q.astype(jnp.float32) * scale
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    do = do.astype(jnp.float32)
+    dl = dl.astype(jnp.float32)
+    nk = max(1, (t_k + tk - 1) // tk)
+    tk_c = _round_up(t_k, nk) // nk if t_k else t_k
+    # pad Tk to an even tile split for the scan
+    tk_pad = nk * tk_c - t_k
+    kf_p = jnp.pad(kf, ((0, 0), (0, tk_pad), (0, 0)))
+    vf_p = jnp.pad(vf, ((0, 0), (0, tk_pad), (0, 0)))
+    k_ids = jnp.arange(nk * tk_c)
+    q_pos = q_off + jnp.arange(t_q)
+
+    def tile(carry, inp):
+        dq_acc, = carry
+        kt, vt, ids = inp  # [BH, tk_c, D], [BH, tk_c, D], [tk_c]
+        s = jnp.einsum("bqd,bkd->bqk", qf, kt)
+        mask = (ids < t_k)[None, :]
+        if causal:
+            mask = mask & (q_pos[:, None] >= (k_off + ids)[None, :])
+        s = jnp.where(mask[None], s, _NEG_INF)
+        p = jnp.where(s > _NEG_INF * 0.5, jnp.exp(s - m[..., None]), 0.0)
+        dp = jnp.einsum("bqd,bkd->bqk", do, vt) + dl[..., None]
+        ds = p * dp
+        dq_acc = dq_acc + jnp.einsum("bqk,bkd->bqd", ds, kt)
+        dkt = jnp.einsum("bqk,bqd->bkd", ds, qf)
+        dvt = jnp.einsum("bqk,bqd->bkd", p, do)
+        return (dq_acc,), (dkt, dvt)
+
+    kts = kf_p.reshape(bh, nk, tk_c, -1).transpose(1, 0, 2, 3)
+    vts = vf_p.reshape(bh, nk, tk_c, -1).transpose(1, 0, 2, 3)
+    idts = k_ids.reshape(nk, tk_c)
+    dq0 = jnp.zeros((bh, t_q, d), jnp.float32)
+    # Under shard_map the accumulator becomes device-varying inside the
+    # scan; its initial value must be marked varying up front.
+    try:
+        vma = frozenset()
+        for a in (q, k, v, do):
+            vma = vma | frozenset(jax.typeof(a).vma)
+        if vma:
+            dq0 = lax.pcast(dq0, tuple(vma), to="varying")
+    except (AttributeError, TypeError):
+        pass
+    (dq,), (dks, dvs) = lax.scan(tile, (dq0,), (kts, vts, idts))
+    dk = dks.transpose(1, 0, 2, 3).reshape(bh, nk * tk_c, -1)[:, :t_k]
+    dv = dvs.transpose(1, 0, 2, 3).reshape(bh, nk * tk_c, -1)[:, :t_k]
+    # Integer (position-offset) primals take float0 cotangents.
+    import numpy as np
+
+    f0 = np.zeros((), jax.dtypes.float0)
+    return (
+        (dq * scale).astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+        f0, f0,
+    )
+
+
+block_flash.defvjp(_block_flash_fwd, _block_flash_bwd)
+
+
+def mlo_merge(state_a, state_b):
+    """Associative combine of two flash partial states (o, m, l)."""
+    o1, m1, l1 = state_a
+    o2, m2, l2 = state_b
+    m = jnp.maximum(m1, m2)
+    c1 = jnp.exp(m1 - m)
+    c2 = jnp.exp(m2 - m)
+    return (
+        o1 * c1[..., None] + o2 * c2[..., None],
+        m,
+        l1 * c1 + l2 * c2,
+    )
+
+
+def flash_attention_local(q, k, v, causal=False, scale=None,
+                          interpret=False):
+    """Single-device exact attention via the block kernel.
+
+    q, k, v: [B, T, H, D] (the ring module's layout).  Returns [B, T, H, D]
+    in q.dtype.  Memory: never materializes [T, T] scores.
+    """
+    b, t, h, d = q.shape
+    sc = scale if scale is not None else float(1.0 / (d ** 0.5))
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+    zero = jnp.zeros((), jnp.int32)
+    o, m, l = block_flash(
+        fold(q), fold(k), fold(v), zero, zero, causal, sc, 256, 512,
+        interpret,
+    )
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3).astype(q.dtype)

@@ -16,8 +16,10 @@ instance, built on the same ``halo_exchange_1d`` primitive:
   axis: K/V blocks circulate the ring via ``lax.ppermute`` while each device
   accumulates its queries' output with a numerically-stable online softmax
   (flash-attention style m/l/o running state).  One hop per step rides the
-  ICI ring; memory per device stays O(T_local²·heads) independent of the
-  global sequence length.
+  ICI ring.  Per-device memory: O(T_local·H·D) on the default TPU path
+  (``use_flash`` auto — the Pallas kernel in ops/pallas_attention.py keeps
+  scores in VMEM tiles); the einsum fallback path materializes the per-hop
+  O(T_local²·heads) score block and serves CPU + as the validation oracle.
 
 All functions must be called inside shard_map with the named axis present.
 """
@@ -74,6 +76,16 @@ def ghost_conv1d(
     )
 
 
+def _resolve_flash(setting: Optional[bool]) -> bool:
+    """None = auto: the Pallas block kernel (ops/pallas_attention.py) is a
+    Mosaic program — on for TPU backends, einsum path elsewhere."""
+    if setting is not None:
+        return setting
+    from mpi4dl_tpu.config import is_tpu_backend
+
+    return is_tpu_backend()
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -82,6 +94,8 @@ def ring_attention(
     n: int,
     causal: bool = False,
     scale: Optional[float] = None,
+    use_flash: Optional[bool] = None,
+    interpret: bool = False,
 ) -> jax.Array:
     """Exact attention over a sequence sharded on `axis_name` ([B, T_local,
     H, D] per device).  K/V blocks rotate around the ring; each device folds
@@ -107,12 +121,27 @@ def ring_attention(
             s = jnp.where(mask[None, None], s, -jnp.inf)
         return s
 
+    flash = _resolve_flash(use_flash)
+
     if axis_name is None:
+        if flash:
+            from mpi4dl_tpu.ops.pallas_attention import flash_attention_local
+
+            return flash_attention_local(
+                q, k, v, causal=causal, scale=scale, interpret=interpret
+            )
         s = block_scores(k, jnp.arange(t), jnp.arange(t))
         out = jnp.einsum(
             "bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), v.astype(jnp.float32)
         )
         return out.astype(q.dtype)
+
+    if flash:
+        return _ring_attention_flash(
+            q, k, v, axis_name, n, causal,
+            float(scale) if scale is not None else 1.0 / float(d) ** 0.5,
+            interpret,
+        )
 
     my = lax.axis_index(axis_name)
     q_pos = my * t + jnp.arange(t)
@@ -146,3 +175,48 @@ def ring_attention(
     (_, _, _, _, l, o), _ = lax.scan(body, (k, v, my, m0, l0, o0), None, length=n)
     out = o / jnp.maximum(l[..., None], 1e-30)
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+def _ring_attention_flash(q, k, v, axis_name, n, causal, scale, interpret):
+    """Ring attention with the Pallas block kernel as the local compute.
+
+    Same schedule as the einsum path (K/V rotate via ppermute, one hop per
+    scan step) but each hop's block state comes from
+    :func:`mpi4dl_tpu.ops.pallas_attention.block_flash` — scores exist only
+    as VMEM tiles, so per-hop HBM traffic drops from O(T_local²·H) to
+    O(T_local·D·H), the long-context enabler.  Exact: block states fold via
+    the associative :func:`mlo_merge` (same update the einsum path applies
+    inline), so results match it to fp accumulation order.
+    """
+    from mpi4dl_tpu.ops.pallas_attention import block_flash, mlo_merge
+
+    b, t, h, d = q.shape
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    qf = fold(q)
+    q_off = my * t
+
+    def body(carry, _):
+        kblk, vblk, src, m, l, o = carry
+        blk = block_flash(  # all-positional: custom_vjp + nondiff args
+            qf, fold(kblk), fold(vblk), q_off, src * t, causal, scale,
+            256, 512, interpret,
+        )
+        o, m, l = mlo_merge((o, m, l), blk)
+        kblk = lax.ppermute(kblk, axis_name, perm)
+        vblk = lax.ppermute(vblk, axis_name, perm)
+        src = lax.ppermute(src, axis_name, perm)
+        return (kblk, vblk, src, m, l, o), None
+
+    vcast = lambda t_: lax.pcast(t_, (axis_name,), to="varying")
+    from mpi4dl_tpu.ops.pallas_attention import _NEG_INF
+
+    m0 = vcast(jnp.full((b * h, t), _NEG_INF, jnp.float32))
+    l0 = vcast(jnp.zeros((b * h, t), jnp.float32))
+    o0 = vcast(jnp.zeros((b * h, t, d), jnp.float32))
+    (_, _, _, _, l, o), _ = lax.scan(
+        body, (k, v, my, m0, l0, o0), None, length=n
+    )
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3).astype(q.dtype)
