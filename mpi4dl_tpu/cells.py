@@ -142,23 +142,27 @@ class CellModel:
         return shapes
 
 
-def _apply_cell_remat(cell: Cell, params, x: Act, ctx: ApplyCtx) -> Act:
-    """Apply one cell under jax.checkpoint.
+def checkpointed_apply(apply_fn, params, x: Act, ctx: ApplyCtx) -> Act:
+    """Run ``apply_fn(params, x, ctx)`` under jax.checkpoint.
 
     When a BN stats sink is active it must cross the checkpoint boundary
     explicitly: the sink captures tracers of the INNER (rematerialized) trace,
     which would escape if consumed outside.  The checkpointed fn therefore
-    returns the cell's stat updates aligned to the cell's flattened param
-    leaves, and they are re-deposited into the outer sink under the OUTER
-    leaves' ids."""
+    returns the stat updates aligned to the flattened param leaves, and they
+    are re-deposited into the outer sink under the OUTER leaves' ids.
+
+    Serves the per-cell remat (model.apply remat=True) and the finer per-op
+    remat inside AmoebaNet cells (ctx.remat_ops — the 'fine' level that
+    bounds backward temps to one op's internals at a time; the
+    max-trainable-resolution lever, PERF_NOTES.md)."""
     import dataclasses as _dc
 
     if ctx.bn_sink is None:
-        return jax.checkpoint(lambda p, x: cell.apply(p, x, ctx))(params, x)
+        return jax.checkpoint(lambda p, x: apply_fn(p, x, ctx))(params, x)
 
     def fn(p, x):
         inner: dict = {}
-        y = cell.apply(p, x, _dc.replace(ctx, bn_sink=inner))
+        y = apply_fn(p, x, _dc.replace(ctx, bn_sink=inner))
         stats = [inner.get(id(leaf)) for leaf in jax.tree.leaves(p)]
         return y, stats
 
@@ -167,6 +171,10 @@ def _apply_cell_remat(cell: Cell, params, x: Act, ctx: ApplyCtx) -> Act:
         if s is not None:
             ctx.bn_sink[id(leaf)] = s
     return y
+
+
+def _apply_cell_remat(cell: Cell, params, x: Act, ctx: ApplyCtx) -> Act:
+    return checkpointed_apply(cell.apply, params, x, ctx)
 
 
 def split_even(n_cells: int, split_size: int, balance: Optional[Sequence[int]] = None

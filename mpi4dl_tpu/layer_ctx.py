@@ -111,6 +111,11 @@ class ApplyCtx:
     # tile device holds a different batch shard).  Stat deposits pmean over
     # these so written-back running stats stay replicated.
     bn_stat_axes: tuple = ()
+    # Fine-grained rematerialization: additionally checkpoint each op inside
+    # composite cells (AmoebaCell reduce/ops), bounding backward temps to one
+    # op at a time — set by make_train_step(remat="fine"); the
+    # max-trainable-resolution configuration (PERF_NOTES.md).
+    remat_ops: bool = False
 
     def with_spatial(self, spatial: Optional[SpatialCtx]) -> "ApplyCtx":
         return dataclasses.replace(self, spatial=spatial)

@@ -213,12 +213,19 @@ class BatchNorm(Layer):
         return params, in_shape
 
     def apply(self, params, x, ctx: ApplyCtx):
+        # Memory discipline (the 2048px→beyond lever, PERF_NOTES.md): never
+        # materialize an fp32 copy of the activation.  Statistics come from
+        # ONE fused sum/sumsq pair with fp32 ACCUMULATION over the original
+        # dtype (XLA fuses the upcast/square into the reductions), and
+        # normalization is folded to y = x·a + b with per-channel fp32
+        # (a, b) precomputed — a single fma in the compute dtype, so both
+        # the forward temp and the backward cotangents stay bf16 under
+        # bf16 compute.
         orig_dtype = x.dtype
-        xf = x.astype(jnp.float32)
         if ctx.train:
             axes = tuple(range(x.ndim - 1))  # all but channel
             sp = ctx.spatial
-            stat_x = xf
+            stat_x = x
             if sp is not None and sp.halo_pre_exchanged and (
                 sp.pre_margin_h or sp.pre_margin_w
             ):
@@ -229,30 +236,31 @@ class BatchNorm(Layer):
                 # exactly.  Normalisation still covers the full extended tile.
                 mh = sp.pre_margin_h if (sp.axis_h and sp.grid_h > 1) else 0
                 mw = sp.pre_margin_w if (sp.axis_w and sp.grid_w > 1) else 0
-                stat_x = xf[:, mh : xf.shape[1] - mh, mw : xf.shape[2] - mw, :]
+                stat_x = x[:, mh : x.shape[1] - mh, mw : x.shape[2] - mw, :]
             cnt = jnp.asarray(
                 math.prod([stat_x.shape[a] for a in axes]), jnp.float32
             )
+            s = jnp.sum(stat_x, axis=axes, dtype=jnp.float32)
+            ss = jnp.sum(
+                jnp.square(stat_x.astype(jnp.float32)), axis=axes
+            )
             if sp is not None and sp.active and sp.bn_cross_tile:
                 # Cross-tile statistics: psum local (count, sum, sumsq).
-                s = jnp.sum(stat_x, axis=axes)
-                ss = jnp.sum(stat_x * stat_x, axis=axes)
                 ax_names = tuple(a for a in (sp.axis_h, sp.axis_w) if a)
                 cnt = lax.psum(cnt, ax_names)
                 s = lax.psum(s, ax_names)
                 ss = lax.psum(ss, ax_names)
-                mean = s / cnt
-                var = ss / cnt - mean * mean
-            else:
-                mean = jnp.mean(stat_x, axis=axes)
-                var = jnp.var(stat_x, axis=axes)
+            mean = s / cnt
+            # E[x²]-E[x]² cancellation can go slightly negative in fp.
+            var = jnp.maximum(ss / cnt - mean * mean, 0.0)
             if ctx.bn_sink is not None:
                 self._deposit_running(params, mean, var, cnt, ctx)
         else:
             mean, var = params["mean"], params["var"]
         inv = lax.rsqrt(var + self.eps) * params["scale"]
-        y = (xf - mean) * inv + params["bias"]
-        return y.astype(orig_dtype)
+        a = inv.astype(orig_dtype)
+        b = (params["bias"] - mean * inv).astype(orig_dtype)
+        return x * a + b
 
     def _deposit_running(self, params, mean, var, cnt, ctx: ApplyCtx):
         """Put momentum-updated running stats into ctx.bn_sink.

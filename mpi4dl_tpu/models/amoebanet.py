@@ -24,7 +24,7 @@ from typing import Any, Callable, List, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-from mpi4dl_tpu.cells import Cell, CellModel, LayerCell
+from mpi4dl_tpu.cells import Cell, CellModel, LayerCell, checkpointed_apply
 from mpi4dl_tpu.layer_ctx import ApplyCtx
 from mpi4dl_tpu.layers import (
     BatchNorm,
@@ -257,13 +257,23 @@ class AmoebaCell(Cell):
             s1, s2 = x
         else:
             s1 = s2 = x
+        if ctx.remat_ops:
+            # Fine remat: each reduce/op is its own checkpoint region, so
+            # the backward holds one op's internals at a time instead of
+            # the whole cell DAG's (max-trainable-resolution lever).
+            app = lambda l, p, s: checkpointed_apply(l.apply, p, s, ctx)
+        else:
+            app = lambda l, p, s: l.apply(p, s, ctx)
         skip = s1
-        s1 = self.reduce1.apply(params["reduce1"], s1, ctx)
-        s2 = self.reduce2.apply(params["reduce2"], s2, ctx)
+        s1 = app(self.reduce1, params["reduce1"], s1)
+        s2 = app(self.reduce2, params["reduce2"], s2)
         states = [s1, s2]
         for j in range(0, len(self.ops), 2):
-            h1 = self.ops[j].apply(params["ops"][j], states[self.indices[j]], ctx)
-            h2 = self.ops[j + 1].apply(params["ops"][j + 1], states[self.indices[j + 1]], ctx)
+            h1 = app(self.ops[j], params["ops"][j], states[self.indices[j]])
+            h2 = app(
+                self.ops[j + 1], params["ops"][j + 1],
+                states[self.indices[j + 1]],
+            )
             states.append(h1 + h2)
         out = jnp.concatenate([states[i] for i in self.concat], axis=-1)
         return (out, skip)
@@ -313,7 +323,7 @@ class AmoebaCell(Cell):
     def _apply_d2(self, params, x, ctx: ApplyCtx, plan):
         """One halo exchange per input state; ops run margin-consuming;
         intermediate states re-align by cropping leftover margin."""
-        from mpi4dl_tpu.ops.d2 import apply_layers_premargin
+        from mpi4dl_tpu.ops.d2 import apply_layers_premargin, premargin_out
         from mpi4dl_tpu.ops.halo import HaloSpec, halo_exchange_2d
 
         sp = ctx.spatial
@@ -334,8 +344,16 @@ class AmoebaCell(Cell):
         else:
             s1_in = s2_in = x
         skip = s1_in
-        s1 = self.reduce1.apply(params["reduce1"], s1_in, ctx)
-        s2 = self.reduce2.apply(params["reduce2"], s2_in, ctx)
+        if ctx.remat_ops:
+            s1 = checkpointed_apply(
+                self.reduce1.apply, params["reduce1"], s1_in, ctx
+            )
+            s2 = checkpointed_apply(
+                self.reduce2.apply, params["reduce2"], s2_in, ctx
+            )
+        else:
+            s1 = self.reduce1.apply(params["reduce1"], s1_in, ctx)
+            s2 = self.reduce2.apply(params["reduce2"], s2_in, ctx)
 
         states = []
         for t, (nh, nw) in ((s1, need[0]), (s2, need[1])):
@@ -353,9 +371,22 @@ class AmoebaCell(Cell):
             outs = []
             for jj in (j, j + 1):
                 t, mh, mw = states[self.indices[jj]]
-                y, mho, mwo = apply_layers_premargin(
-                    self.ops[jj].layers, params["ops"][jj], t, ctx, mh, mw
-                )
+                if ctx.remat_ops:
+                    # Fine remat in the fused path: the checkpoint returns
+                    # arrays only, so the static margins are re-derived by
+                    # premargin_out (pure arithmetic).
+                    def op_fn(p, tt, c, _l=self.ops[jj].layers,
+                              _mh=mh, _mw=mw):
+                        return apply_layers_premargin(_l, p, tt, c, _mh, _mw)[0]
+
+                    y = checkpointed_apply(op_fn, params["ops"][jj], t, ctx)
+                    mho, mwo = premargin_out(
+                        self.ops[jj].layers, ctx, mh, mw
+                    )
+                else:
+                    y, mho, mwo = apply_layers_premargin(
+                        self.ops[jj].layers, params["ops"][jj], t, ctx, mh, mw
+                    )
                 outs.append(crop(y, mho - tnh, mwo - tnw))
             states.append((outs[0] + outs[1], tnh, tnw))
 

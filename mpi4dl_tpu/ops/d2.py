@@ -124,6 +124,23 @@ def apply_layers_premargin(layers: Sequence, params_seq, x, ctx: ApplyCtx,
     return x, mh, mw
 
 
+def premargin_out(layers: Sequence, ctx: ApplyCtx, mh: int, mw: int):
+    """The (mh_out, mw_out) that :func:`apply_layers_premargin` would return
+    — pure static margin arithmetic, no compute.  Lets callers wrap the
+    compute in jax.checkpoint (whose outputs must be arrays, not the static
+    margin ints) and recover the margins outside (ctx.remat_ops path)."""
+    sp = ctx.spatial
+    sharded_h = bool(sp.axis_h) and sp.grid_h > 1
+    sharded_w = bool(sp.axis_w) and sp.grid_w > 1
+    for layer in layers:
+        ph, pw, sh, sw, *_ = layer_d2_geometry(layer)
+        if sharded_h:
+            mh = (mh - ph) // sh
+        if sharded_w:
+            mw = (mw - pw) // sw
+    return mh, mw
+
+
 def run_layers_d2(layers: Sequence, params_seq, x, ctx: ApplyCtx):
     """Apply a fused run: one accumulated halo exchange, then every layer in
     pre-exchanged (margin-consuming) mode."""

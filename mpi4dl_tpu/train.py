@@ -196,14 +196,19 @@ def make_train_step(
     `parts` > 1 runs the micro-batch gradient-accumulation loop via lax.scan —
     the degenerate (split_size=1) form of the reference's GPipe parts loop.
     `remat=True` checkpoints per cell (memory for FLOPs — required for the
-    reference's high-resolution configs at batch 1 on one chip).
+    reference's high-resolution configs at batch 1 on one chip);
+    `remat="fine"` additionally checkpoints each op inside composite cells
+    (ctx.remat_ops — bounds backward temps to one op at a time, the
+    max-trainable-resolution configuration).
     `bn_stats=True` (default) updates BN running statistics each step (torch
     nn.BatchNorm2d semantics; with parts>1 the update uses the batch stats
     averaged over microbatches, which the momentum rule makes equivalent to
     averaging the per-microbatch updated values).
     """
-    ctx = ApplyCtx(train=True)
-    loss_fn = make_loss_fn(model, ctx, from_probs, remat=remat, with_stats=bn_stats)
+    ctx = ApplyCtx(train=True, remat_ops=(remat == "fine"))
+    loss_fn = make_loss_fn(
+        model, ctx, from_probs, remat=bool(remat), with_stats=bn_stats
+    )
 
     def grads_for(params, x, labels):
         (loss, (logits, stats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(

@@ -148,3 +148,34 @@ def test_spatial_stats_match_single_device(devices8):
     np.testing.assert_allclose(
         float(e_ref["accuracy"]), float(e_sp["accuracy"]), rtol=1e-6
     )
+
+
+def test_fine_remat_matches_plain_on_amoebanet():
+    """remat="fine" (per-op checkpoints inside AmoebaCells, ctx.remat_ops)
+    must reproduce the plain step's updates — incl. BN running stats crossing
+    the nested checkpoint boundaries."""
+    from mpi4dl_tpu.models.amoebanet import amoebanetd
+
+    model = amoebanetd((2, 32, 32, 3), num_classes=5, num_layers=3,
+                       num_filters=16)
+    params, _ = model.init(jax.random.key(0))
+    opt = Optimizer("sgd", lr=0.01)
+    x = jax.random.normal(jax.random.key(3), (2, 32, 32, 3))
+    y = jnp.array([0, 1], jnp.int32)
+
+    s_plain = TrainState.create(params, opt)
+    s_fine = TrainState.create(params, opt)
+    step_plain = make_train_step(model, opt)
+    step_fine = make_train_step(model, opt, remat="fine")
+    for _ in range(2):
+        s_plain, m_p = step_plain(s_plain, x, y)
+        s_fine, m_f = step_fine(s_fine, x, y)
+    np.testing.assert_allclose(
+        float(m_p["loss"]), float(m_f["loss"]), rtol=1e-6
+    )
+    for a, b in zip(
+        jax.tree.leaves(s_plain.params), jax.tree.leaves(s_fine.params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+        )

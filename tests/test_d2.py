@@ -250,7 +250,9 @@ def test_amoeba_cell_d2_matches_emulation(devices8):
 
     got = _sharded_apply(cell, params, x, sp, mesh)
     want = _emulate_cell_d2(cell, params, x, 4)
-    np.testing.assert_allclose(np.asarray(got[0]), np.asarray(want), atol=2e-5)
+    # atol: BN's single-pass fused statistics (layers.py) reduce in a
+    # different order on the sharded run vs the pad-once emulation.
+    np.testing.assert_allclose(np.asarray(got[0]), np.asarray(want), atol=1e-4)
     np.testing.assert_array_equal(np.asarray(got[1]), np.asarray(x))  # skip
 
 
@@ -335,3 +337,29 @@ def test_d2_pool_warning(devices8):
         warnings.simplefilter("always")
         trace(conv_cell)
     assert not any("pad-once" in str(x.message) for x in w)
+
+
+def test_amoeba_cell_d2_remat_ops_matches_plain(devices8):
+    """ctx.remat_ops must flow through the D2 fused path (per-op checkpoints
+    around apply_layers_premargin, margins re-derived by premargin_out) and
+    reproduce the un-checkpointed D2 output exactly."""
+    from mpi4dl_tpu.models.amoebanet import AmoebaCell
+
+    cell = AmoebaCell(32, 32, 32, reduction=False, reduction_prev=False)
+    params, _ = cell.init(jax.random.key(0), (1, 32, 32, 32))
+    x = jax.random.normal(jax.random.key(1), (1, 32, 32, 32))
+    sp = SpatialCtx(axis_w="spw", grid_w=4, d2_mode=True)
+    mesh = build_mesh(MeshSpec(data=1, stage=1, sph=1, spw=4), jax.devices()[:4])
+
+    plain = _sharded_apply(cell, params, x, sp, mesh)
+
+    ctx = ApplyCtx(train=True, spatial=sp, remat_ops=True)
+    spec = P(None, sp.axis_h, sp.axis_w, None)
+    fine = jax.jit(
+        shard_map(
+            lambda t: cell.apply(params, t, ctx),
+            mesh=mesh, in_specs=spec, out_specs=spec,
+        )
+    )(x)
+    np.testing.assert_array_equal(np.asarray(fine[0]), np.asarray(plain[0]))
+    np.testing.assert_array_equal(np.asarray(fine[1]), np.asarray(plain[1]))

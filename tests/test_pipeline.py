@@ -53,10 +53,12 @@ def test_pipeline_matches_single_device(devices8, parts, split_size):
         )
 
     # Parameter buffers must match the reference step's updated params.
+    # atol: BN's single-pass fused statistics (layers.py) shift reduction
+    # order between the packed-buffer and reference executions.
     got = part.unpack_params(np.asarray(pstate.param_buf))
     want = jax.tree.leaves(ref_state.params)
     for a, b in zip(jax.tree.leaves(got), want):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=5e-5)
 
 
 def test_pipeline_amoebanet_tuple_state(devices8):
