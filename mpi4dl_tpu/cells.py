@@ -114,15 +114,38 @@ class CellModel:
 
     def apply(self, params_list, x: Act, ctx: ApplyCtx, *,
               start: int = 0, stop: Optional[int] = None,
-              remat: bool = False) -> Act:
+              remat=False) -> Act:
         """Run cells [start, stop) — the per-stage sub-model.
 
         ``remat=True`` wraps each cell in :func:`jax.checkpoint` so backward
         recomputes activations per cell instead of storing them — the memory
         lever that lets high-resolution configs (the reference's 1024²-2048²
         charts, BASELINE.md) fit on a single chip.
+
+        ``remat="sqrt"`` adds a second checkpoint level: cells run in ~√n
+        groups, the OUTER checkpoint saves only group-boundary activations
+        and the inner per-cell checkpoints exist transiently during one
+        group's backward — O(√n) live boundaries instead of O(n), the
+        classic two-level recursive schedule (deep ResNets hold 55 block
+        boundaries at high resolution; this is what lets them fit).
         """
         stop = len(self.cells) if stop is None else stop
+        if remat == "sqrt" and stop - start > 3:
+            import math as _m
+
+            n = stop - start
+            for lo, hi in split_even(n, max(2, _m.isqrt(n))):
+                grp = tuple(range(start + lo, start + hi))
+
+                def grp_fn(ps, x, c, _grp=grp):
+                    for k, i in enumerate(_grp):
+                        x = _apply_cell_remat(self.cells[i], ps[k], x, c)
+                    return x
+
+                x = checkpointed_apply(
+                    grp_fn, [params_list[i] for i in grp], x, ctx
+                )
+            return x
         for i in range(start, stop):
             if remat:
                 x = _apply_cell_remat(self.cells[i], params_list[i], x, ctx)

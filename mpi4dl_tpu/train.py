@@ -165,9 +165,10 @@ def merge_stat_updates(params, updates: Optional[list]):
 
 
 def make_loss_fn(model: CellModel, ctx: ApplyCtx, from_probs: bool = False,
-                 remat: bool = False, with_stats: bool = False):
+                 remat=False, with_stats: bool = False):
     """Loss fn returning ``(loss, (logits, stat_updates))``; stat_updates is
-    None unless with_stats (then a leaf-aligned BN running-stat update list)."""
+    None unless with_stats (then a leaf-aligned BN running-stat update list).
+    ``remat`` is forwarded to ``CellModel.apply`` (False/True/"sqrt")."""
 
     def loss_fn(params_list, x, labels):
         c = dataclasses.replace(ctx, bn_sink={}) if with_stats else ctx
@@ -197,17 +198,21 @@ def make_train_step(
     the degenerate (split_size=1) form of the reference's GPipe parts loop.
     `remat=True` checkpoints per cell (memory for FLOPs — required for the
     reference's high-resolution configs at batch 1 on one chip);
-    `remat="fine"` additionally checkpoints each op inside composite cells
-    (ctx.remat_ops — bounds backward temps to one op at a time, the
-    max-trainable-resolution configuration).
+    `remat="sqrt"` runs cells in ~√n two-level checkpoint groups (O(√n)
+    live cell boundaries); `remat="fine"` keeps per-cell checkpoints and
+    adds per-op checkpoints inside composite cells (ctx.remat_ops) — the
+    max-trainable-resolution configuration for AmoebaNet (measured:
+    boundary mass, not within-op temps, is what "fine" removes there;
+    PERF_NOTES.md).
     `bn_stats=True` (default) updates BN running statistics each step (torch
     nn.BatchNorm2d semantics; with parts>1 the update uses the batch stats
     averaged over microbatches, which the momentum rule makes equivalent to
     averaging the per-microbatch updated values).
     """
     ctx = ApplyCtx(train=True, remat_ops=(remat == "fine"))
+    model_remat = "sqrt" if remat == "sqrt" else bool(remat)
     loss_fn = make_loss_fn(
-        model, ctx, from_probs, remat=bool(remat), with_stats=bn_stats
+        model, ctx, from_probs, remat=model_remat, with_stats=bn_stats
     )
 
     def grads_for(params, x, labels):

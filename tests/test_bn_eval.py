@@ -179,3 +179,30 @@ def test_fine_remat_matches_plain_on_amoebanet():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
         )
+
+
+def test_sqrt_remat_matches_plain_on_resnet():
+    """remat="sqrt" (two-level group checkpointing) must reproduce the plain
+    step exactly on a deep ResNet (many cell boundaries)."""
+    from mpi4dl_tpu.models.resnet import get_resnet_v2
+
+    model = get_resnet_v2((2, 32, 32, 3), depth=29, num_classes=5)
+    params, _ = model.init(jax.random.key(0))
+    opt = Optimizer("sgd", lr=0.01)
+    x = jax.random.normal(jax.random.key(4), (2, 32, 32, 3))
+    y = jnp.array([0, 1], jnp.int32)
+
+    s_plain = TrainState.create(params, opt)
+    s_sqrt = TrainState.create(params, opt)
+    step_plain = make_train_step(model, opt)
+    step_sqrt = make_train_step(model, opt, remat="sqrt")
+    for _ in range(2):
+        s_plain, m_p = step_plain(s_plain, x, y)
+        s_sqrt, m_s = step_sqrt(s_sqrt, x, y)
+    np.testing.assert_allclose(float(m_p["loss"]), float(m_s["loss"]), rtol=1e-6)
+    for a, b in zip(
+        jax.tree.leaves(s_plain.params), jax.tree.leaves(s_sqrt.params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+        )
