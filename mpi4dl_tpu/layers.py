@@ -109,6 +109,33 @@ class Conv2d(Layer):
         ow = (w + 2 * pw - kw) // sw + 1
         return params, (n, oh, ow, self.out_channels)
 
+    @staticmethod
+    def _pallas_dispatchable(sp, kh, kw, sh, sw, groups, kernel) -> bool:
+        """Route this conv through the Pallas margin-consuming kernel?
+        Stride 1, no groups, not 1x1 (a pure matmul XLA already handles),
+        weight slab within the VMEM cap in both directions."""
+        if not (sp is not None and sp.use_pallas_conv):
+            return False
+        if (sh, sw) != (1, 1) or (kh, kw) == (1, 1) or groups != 1:
+            return False
+        from mpi4dl_tpu.ops.pallas_conv import pallas_conv_eligible
+
+        return pallas_conv_eligible(
+            kernel.shape[2], kernel.shape[3], kernel.shape[0],
+            kernel.shape[1], itemsize=kernel.dtype.itemsize,
+        )
+
+    @staticmethod
+    def _pallas_apply(params, x, kernel, pads, has_bias):
+        from mpi4dl_tpu.ops.pallas_conv import halo_conv2d_t
+
+        if any(p != (0, 0) for p in pads):
+            x = jnp.pad(x, pads)
+        y = halo_conv2d_t(x, kernel)
+        if has_bias:
+            y = y + params["bias"].astype(y.dtype)
+        return y
+
     def apply(self, params, x, ctx: ApplyCtx):
         kh, kw, sh, sw, ph, pw = self._geometry()
         kernel = params["kernel"].astype(x.dtype)
@@ -133,33 +160,28 @@ class Conv2d(Layer):
                 (0, 0) if halo_h.lo else (ph, ph),
                 (0, 0) if halo_w.lo else (pw, pw),
             )
-            from mpi4dl_tpu.ops.pallas_conv import (
-                halo_conv2d_t, pallas_conv_eligible,
-            )
-
-            if (
-                sp.use_pallas_conv
-                and (sh, sw) == (1, 1)
-                and self.feature_group_count == 1
-                and pallas_conv_eligible(
-                    kernel.shape[2], kernel.shape[3],
-                    kernel.shape[0], kernel.shape[1],
-                    itemsize=kernel.dtype.itemsize,
-                )
+            if self._pallas_dispatchable(
+                sp, kh, kw, sh, sw, self.feature_group_count, kernel
             ):
                 # Pallas margin-consuming kernel (ops/pallas_conv.py): wants
                 # the margin present on BOTH dims — explicitly pad any dim
                 # whose padding wasn't realized by halo exchange.
-
-                pads = [(0, 0), padding[0], padding[1], (0, 0)]
-                if any(p != (0, 0) for p in pads):
-                    x = jnp.pad(x, pads)
-                y = halo_conv2d_t(x, kernel)
-                if self.bias:
-                    y = y + params["bias"].astype(y.dtype)
-                return y
+                return self._pallas_apply(
+                    params, x, kernel,
+                    [(0, 0), padding[0], padding[1], (0, 0)], self.bias,
+                )
         else:
             padding = ((ph, ph), (pw, pw))
+            if self._pallas_dispatchable(
+                sp, kh, kw, sh, sw, self.feature_group_count, kernel
+            ):
+                # Unsharded dispatch of the same kernel (an INACTIVE
+                # SpatialCtx can still carry use_pallas_conv): SAME = pad +
+                # margin-consuming VALID.
+                return self._pallas_apply(
+                    params, x, kernel,
+                    [(0, 0), (ph, ph), (pw, pw), (0, 0)], self.bias,
+                )
         y = lax.conv_general_dilated(
             x,
             kernel,

@@ -191,6 +191,7 @@ def make_train_step(
     remat: bool = False,
     bn_stats: bool = True,
     donate: bool = False,
+    pallas_conv: bool = False,
 ):
     """Single-device or DP (batch sharded over 'data') training step.
 
@@ -209,7 +210,18 @@ def make_train_step(
     averaged over microbatches, which the momentum rule makes equivalent to
     averaging the per-microbatch updated values).
     """
-    ctx = ApplyCtx(train=True, remat_ops=(remat == "fine"))
+    if pallas_conv and mesh is not None:
+        raise ValueError(
+            "pallas_conv=True is a single-device dispatch (pallas_call has "
+            "no GSPMD partitioning rule under a pjit mesh); for sharded "
+            "runs set use_pallas_conv on the SpatialCtx inside shard_map"
+        )
+    sp_knobs = (
+        SpatialCtx(use_pallas_conv=True) if pallas_conv else None
+    )
+    ctx = ApplyCtx(
+        train=True, remat_ops=(remat == "fine"), spatial=sp_knobs
+    )
     model_remat = "sqrt" if remat == "sqrt" else bool(remat)
     loss_fn = make_loss_fn(
         model, ctx, from_probs, remat=model_remat, with_stats=bn_stats

@@ -176,3 +176,41 @@ def test_spatial_train_step_with_pallas_conv_exact(devices8):
         np.testing.assert_allclose(float(m_ref["loss"]), float(m["loss"]), rtol=1e-4)
     for a, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(ref_state.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=1e-5)
+
+
+def test_single_device_pallas_train_step_matches_plain():
+    """make_train_step(pallas_conv=True) — the unsharded dispatch (SAME =
+    pad + margin-consuming VALID via an inactive SpatialCtx) — must match
+    the plain XLA step."""
+    from mpi4dl_tpu.cells import CellModel, LayerCell
+    from mpi4dl_tpu.layers import Conv2d, Dense, Flatten, ReLU
+    from mpi4dl_tpu.train import Optimizer, TrainState, make_train_step
+
+    cells = [
+        LayerCell([Conv2d(3, 8, 3), ReLU()], name="c0"),
+        LayerCell([Flatten(), Dense(8 * 16 * 16, 5)], name="head"),
+    ]
+    model = CellModel(cells, (2, 16, 16, 3), 5)
+    params, _ = model.init(jax.random.key(0))
+    opt = Optimizer("sgd", lr=0.01)
+    x = jax.random.normal(jax.random.key(1), (2, 16, 16, 3))
+    y = jnp.arange(2, dtype=jnp.int32)
+
+    s_plain = TrainState.create(params, opt)
+    s_pallas = TrainState.create(params, opt)
+    step_plain = make_train_step(model, opt)
+    step_pallas = make_train_step(model, opt, pallas_conv=True)
+    for _ in range(2):
+        s_plain, m_p = step_plain(s_plain, x, y)
+        s_pallas, m_q = step_pallas(s_pallas, x, y)
+        np.testing.assert_allclose(
+            float(m_p["loss"]), float(m_q["loss"]), rtol=1e-4
+        )
+    # rtol: on a TPU host the real Mosaic kernel runs (fp32 MXU accumulation
+    # order differs from XLA's conv) — same tolerance as the sharded test.
+    for a, b in zip(
+        jax.tree.leaves(s_plain.params), jax.tree.leaves(s_pallas.params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=1e-5
+        )
