@@ -160,28 +160,29 @@ class Conv2d(Layer):
                 (0, 0) if halo_h.lo else (ph, ph),
                 (0, 0) if halo_w.lo else (pw, pw),
             )
-            if self._pallas_dispatchable(
-                sp, kh, kw, sh, sw, self.feature_group_count, kernel
-            ):
-                # Pallas margin-consuming kernel (ops/pallas_conv.py): wants
-                # the margin present on BOTH dims — explicitly pad any dim
-                # whose padding wasn't realized by halo exchange.
-                return self._pallas_apply(
-                    params, x, kernel,
-                    [(0, 0), padding[0], padding[1], (0, 0)], self.bias,
-                )
+            # Sharded runs use the Pallas margin-consuming kernel (measured
+            # faster than the unfusable VALID conv on an exchanged margin).
+            use_pallas = True
         else:
             padding = ((ph, ph), (pw, pw))
-            if self._pallas_dispatchable(
-                sp, kh, kw, sh, sw, self.feature_group_count, kernel
-            ):
-                # Unsharded dispatch of the same kernel (an INACTIVE
-                # SpatialCtx can still carry use_pallas_conv): SAME = pad +
-                # margin-consuming VALID.
-                return self._pallas_apply(
-                    params, x, kernel,
-                    [(0, 0), (ph, ph), (pw, pw), (0, 0)], self.bias,
-                )
+            # Unsharded dispatch only for an AXIS-FREE knob carrier (the
+            # explicit make_train_step(pallas_conv=True) route) — NOT for
+            # degenerate multi-level SP levels (grid 1, rep>1: inactive but
+            # axis-bearing), whose full-image SAME convs measured 35% slower
+            # on this path (PERF_NOTES.md).
+            use_pallas = (
+                sp is not None and sp.axis_h is None and sp.axis_w is None
+            )
+        if use_pallas and self._pallas_dispatchable(
+            sp, kh, kw, sh, sw, self.feature_group_count, kernel
+        ):
+            # The kernel wants the margin present on BOTH dims — pad any dim
+            # whose margin wasn't realized by halo exchange (all of them in
+            # the unsharded case: SAME = pad + margin-consuming VALID).
+            return self._pallas_apply(
+                params, x, kernel,
+                [(0, 0), padding[0], padding[1], (0, 0)], self.bias,
+            )
         y = lax.conv_general_dilated(
             x,
             kernel,
