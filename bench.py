@@ -271,6 +271,20 @@ def _inner_probe(image_size: int) -> None:
                       "first_step_s": round(dt, 1)}))
 
 
+def _stderr_gist(stderr: str) -> str:
+    """The most informative failure line (OOM/compile errors name themselves
+    mid-log; a raw tail often lands on a useless traceback fragment)."""
+    import re
+
+    for line in reversed((stderr or "").splitlines()):
+        if re.search(
+            r"Ran out of memory|RESOURCE_EXHAUSTED|Out of memory|"
+            r"XLA:TPU compile|UNAVAILABLE|\w*Error\b|error:", line,
+        ):
+            return line.strip()[-300:]
+    return (stderr or "")[-300:]
+
+
 def _run_sub(argv_tail, timeout_s, platform="tpu"):
     env = dict(os.environ)
     if platform == "cpu":
@@ -283,8 +297,8 @@ def _run_sub(argv_tail, timeout_s, platform="tpu"):
             capture_output=True, text=True, timeout=timeout_s,
         )
     except subprocess.TimeoutExpired as e:
-        tail = (e.stderr or "")[-300:] if isinstance(e.stderr, str) else ""
-        return None, f"timeout after {timeout_s}s; stderr tail: {tail}"
+        tail = _stderr_gist(e.stderr if isinstance(e.stderr, str) else "")
+        return None, f"timeout after {timeout_s}s; stderr: {tail}"
     sys.stderr.write(proc.stderr[-2000:] if proc.stderr else "")
     for line in reversed(proc.stdout.splitlines()):
         line = line.strip()
@@ -293,7 +307,7 @@ def _run_sub(argv_tail, timeout_s, platform="tpu"):
                 return json.loads(line), None
             except json.JSONDecodeError:
                 continue
-    return None, f"rc={proc.returncode}; stderr tail: {(proc.stderr or '')[-300:]}"
+    return None, f"rc={proc.returncode}; stderr: {_stderr_gist(proc.stderr)}"
 
 
 def _try_rung(name, platform, image_size, num_layers, num_filters,
@@ -328,7 +342,7 @@ def _max_trainable_px(start: int = 2048, cap: int = 8192,
         ok = bool(result and result.get("ok"))
         attempts[str(px)] = (
             {"ok": True, "first_step_s": result.get("first_step_s")} if ok
-            else {"ok": False, "error": (err or "no output")[-120:]}
+            else {"ok": False, "error": (err or "no output")[-300:]}
         )
         print(f"[bench] probe {px}px: {'fits' if ok else 'FAILS'}", file=sys.stderr)
         return ok

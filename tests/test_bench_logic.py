@@ -1,0 +1,97 @@
+"""bench.py control logic — the driver records its output every round, so
+the ladder / max-resolution probe / error-surface behavior is pinned here
+with a mocked subprocess runner (no TPU, no model builds)."""
+
+import importlib
+import os
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def bench(monkeypatch):
+    monkeypatch.syspath_prepend(_REPO)
+    mod = importlib.import_module("bench")
+    # Freeze the wall clock budget: tests must not depend on elapsed time.
+    monkeypatch.setattr(mod, "_time_left", lambda: 10_000.0)
+    return mod
+
+
+def _fake_runner(fits_px):
+    """A _run_sub substitute: probes succeed iff px <= fits_px."""
+    calls = []
+
+    def run(argv_tail, timeout_s, platform="tpu"):
+        assert argv_tail[0] == "--probe"
+        px = int(argv_tail[1])
+        calls.append(px)
+        if px <= fits_px:
+            return {"ok": True, "image_size": px, "first_step_s": 1.0}, None
+        return None, "rc=1; stderr: Ran out of memory in memory space hbm"
+
+    run.calls = calls
+    return run
+
+
+def test_max_trainable_px_doubling_and_midpoint(bench, monkeypatch):
+    """2048 seed fits, 4096 fails -> midpoint 3072 probed; exactly the
+    attempt sequence the real TPU run takes."""
+    runner = _fake_runner(fits_px=3500)
+    monkeypatch.setattr(bench, "_run_sub", runner)
+    best, attempts = bench._max_trainable_px(start=4096, known_fit=2048)
+    assert best == 3072
+    assert runner.calls == [4096, 3072]
+    assert attempts["4096"]["ok"] is False
+    assert "Ran out of memory" in attempts["4096"]["error"]
+    assert attempts["3072"]["ok"] is True
+
+
+def test_max_trainable_px_full_ladder(bench, monkeypatch):
+    """No seed: doubling from 2048 up to the cap, then refine."""
+    runner = _fake_runner(fits_px=10_000)
+    monkeypatch.setattr(bench, "_run_sub", runner)
+    best, _ = bench._max_trainable_px(start=2048, cap=8192)
+    assert best == 8192  # cap reached; no midpoint beyond it
+    assert runner.calls == [2048, 4096, 8192]
+
+
+def test_max_trainable_px_nothing_fits(bench, monkeypatch):
+    runner = _fake_runner(fits_px=0)
+    monkeypatch.setattr(bench, "_run_sub", runner)
+    best, attempts = bench._max_trainable_px(start=1024, known_fit=0)
+    assert best == 0
+    assert runner.calls == [1024]
+    assert attempts["1024"]["ok"] is False
+
+
+def test_max_trainable_px_deadline_stops_probing(bench, monkeypatch):
+    """Past the wall-clock budget the probe records the reason and stops —
+    the driver must still get its one JSON line."""
+    monkeypatch.setattr(bench, "_time_left", lambda: 10.0)
+    runner = _fake_runner(fits_px=10_000)
+    monkeypatch.setattr(bench, "_run_sub", runner)
+    best, attempts = bench._max_trainable_px(start=2048, known_fit=1024)
+    assert best == 1024
+    assert runner.calls == []
+    assert attempts["2048"]["error"] == "bench deadline reached"
+
+
+def test_stderr_gist_prefers_informative_line(bench):
+    log = (
+        "WARNING: something\n"
+        "E0000 XLA:TPU compile permanent error. Ran out of memory in hbm.\n"
+        "For simplicity, JAX has removed its internal frames from the "
+        "traceback of the following exception.\n"
+    )
+    gist = bench._stderr_gist(log)
+    assert "Ran out of memory" in gist
+    assert "internal frames" not in gist
+
+
+def test_stderr_gist_python_exception_lines(bench):
+    assert "ValueError" in bench._stderr_gist(
+        "noise\nValueError: tile H not divisible by stride\ntail\n"
+    )
