@@ -95,3 +95,33 @@ def test_stderr_gist_python_exception_lines(bench):
     assert "ValueError" in bench._stderr_gist(
         "noise\nValueError: tile H not divisible by stride\ntail\n"
     )
+
+
+def test_ladder_clamps_to_deadline(bench, monkeypatch):
+    """Rung timeouts clamp to the remaining global budget and rungs skip
+    entirely once it is spent — the driver always gets its JSON line within
+    DEADLINE_S even with two 1800 s headline rungs in the ladder."""
+    seen = []
+
+    def fake_try(name, *args):
+        seen.append((name, args[6]))  # (name, timeout_s)
+        return None, f"{name}: simulated failure"
+
+    monkeypatch.setattr(bench, "_try_rung", fake_try)
+    monkeypatch.setattr(bench, "_time_left", lambda: 500.0)
+    monkeypatch.setattr(
+        bench.sys, "argv", ["bench.py"]
+    )
+    import io
+    import contextlib
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = bench.main()
+    assert rc == 0
+    import json
+
+    out = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert out["value"] == 0 and "error" in out
+    # every attempted rung was clamped below the 500 s remaining budget
+    assert seen and all(t <= 440 for _, t in seen)
