@@ -40,6 +40,7 @@ import time
 BASELINE_CLUSTER = 2.1   # reference: AmoebaNet-D 1024² bs1, SP square + D2, 5 GPUs
 BASELINE_DEVICES = 5
 BASELINE_2048 = 2.85     # reference: AmoebaNet-D 2048² bs1, SP vertical + D2, 5 GPUs
+BASELINE_1024_BS2 = 2.95  # reference: AmoebaNet-D 1024² bs2, SP square + D2, 5 GPUs
 
 # bf16 peak FLOP/s by TPU generation (public numbers); matched by substring of
 # jax.devices()[0].device_kind.  Used only for the mfu sanity check.
@@ -160,7 +161,7 @@ def _measure(step, state, xs, ys, iters: int, blocked: bool):
 
 def _inner(platform: str, image_size: int, num_layers: int, num_filters: int,
            warmup: int, iters: int, comparable: bool,
-           remat="cell") -> None:
+           remat="cell", batch: int = 1) -> None:
     import jax
     import jax.numpy as jnp
 
@@ -175,7 +176,6 @@ def _inner(platform: str, image_size: int, num_layers: int, num_filters: int,
         print(f"[bench] wanted {platform!r}, got {dev.platform!r} — bail",
               file=sys.stderr)
         sys.exit(3)
-    batch = 1
 
     step, state = _build_step(
         image_size, num_layers, num_filters, batch, remat=_REMAT[remat]
@@ -324,16 +324,34 @@ def _run_sub(argv_tail, timeout_s, platform="tpu"):
 
 
 def _try_rung(name, platform, image_size, num_layers, num_filters,
-              warmup, iters, timeout_s, comparable, remat="cell"):
+              warmup, iters, timeout_s, comparable, remat="cell",
+              batch=1):
     tail = ["--inner", platform, str(image_size), str(num_layers),
             str(num_filters), str(warmup), str(iters),
-            "1" if comparable else "0", remat]
+            "1" if comparable else "0", remat, str(batch)]
     result, err = _run_sub(tail, timeout_s, platform)
     if err:
         err = f"{name}: {err}"
     if result is not None:
         result["remat"] = remat
     return result, err
+
+
+def _rung_summary(result, err, baseline, baseline_key):
+    """Uniform per-rung summary dict for the `rungs` section."""
+    if result is None:
+        return {"error": (err or "")[-200:]}
+    out = {
+        "img_per_sec": result["value"],
+        "mfu": result.get("mfu"),
+        "timing_mode": result.get("timing_mode"),
+        "remat": result.get("remat"),
+        baseline_key: (
+            round(result["value"] / baseline, 4)
+            if not result.get("error") else None
+        ),
+    }
+    return out
 
 
 def _max_trainable_px(start: int = 2048, cap: int = 8192,
@@ -380,8 +398,9 @@ def main() -> int:
     if len(sys.argv) > 1 and sys.argv[1] == "--inner":
         platform, image_size, num_layers, num_filters, warmup, iters, comp = sys.argv[2:9]
         remat = sys.argv[9] if len(sys.argv) > 9 else "cell"
+        batch = int(sys.argv[10]) if len(sys.argv) > 10 else 1
         _inner(platform, int(image_size), int(num_layers), int(num_filters),
-               int(warmup), int(iters), comp == "1", remat)
+               int(warmup), int(iters), comp == "1", remat, batch)
         return 0
     if len(sys.argv) > 1 and sys.argv[1] == "--probe":
         _inner_probe(int(sys.argv[2]))
@@ -428,18 +447,26 @@ def main() -> int:
             "tpu_2048", "tpu", 2048, 18, 416, 1, 4,
             min(1800, max(300, _time_left() - 300)), False,
         )
-        if r2048 is not None:
-            headline["rungs"] = {"2048": {
-                "img_per_sec": r2048["value"],
-                "mfu": r2048.get("mfu"),
-                "timing_mode": r2048.get("timing_mode"),
-                "vs_baseline_cluster_2048": (
-                    round(r2048["value"] / BASELINE_2048, 4)
-                    if not r2048.get("error") else None
-                ),
-            }}
-        else:
-            headline["rungs"] = {"2048": {"error": (err or "")[-200:]}}
+        headline["rungs"] = {
+            "2048": _rung_summary(r2048, err, BASELINE_2048,
+                                  "vs_baseline_cluster_2048"),
+        }
+        # Batch-2 rung at the flagship resolution (the reference's best bs2
+        # chart point); no-remat first, remat fallback on OOM.
+        print("[bench] 1024px bs2 rung", file=sys.stderr)
+        r_bs2, bs2_err = None, "skipped (bench deadline reached)"
+        for rm in ("none", "cell"):
+            if _time_left() < 300:
+                break
+            r_bs2, bs2_err = _try_rung(
+                "tpu_1024_bs2", "tpu", 1024, 18, 416, 1, 4,
+                min(1200, max(300, _time_left() - 300)), False, rm, 2,
+            )
+            if r_bs2 is not None:
+                break
+        headline["rungs"]["1024_bs2"] = _rung_summary(
+            r_bs2, bs2_err, BASELINE_1024_BS2, "vs_baseline_cluster_1024_bs2"
+        )
         # Max trainable resolution per chip (driver north-star metric).  The
         # 2048 rung above already proved (or failed) that resolution — seed
         # the ladder instead of re-compiling it.

@@ -125,3 +125,17 @@ def test_ladder_clamps_to_deadline(bench, monkeypatch):
     assert out["value"] == 0 and "error" in out
     # every attempted rung was clamped below the 500 s remaining budget
     assert seen and all(t <= 440 for _, t in seen)
+
+
+def test_rung_summary_shapes(bench):
+    ok = bench._rung_summary(
+        {"value": 0.7, "mfu": 0.1, "timing_mode": "async_chain",
+         "remat": "cell"},
+        None, 2.85, "vs_baseline_cluster_2048",
+    )
+    assert ok["img_per_sec"] == 0.7
+    assert ok["vs_baseline_cluster_2048"] == round(0.7 / 2.85, 4)
+    skipped = bench._rung_summary(
+        None, "skipped (bench deadline reached)", 2.95, "k"
+    )
+    assert skipped == {"error": "skipped (bench deadline reached)"}
