@@ -15,12 +15,14 @@ between timestamps) with more iterations and fresh inputs each step.  If even
 the blocked measurement lands above peak, ``vs_baseline`` is null and an
 ``error`` explains.
 
-Memory-capability rungs (round 3): in addition to the 1024² headline, the
-JSON carries a 2048² bs1 measurement (the reference's OOM frontier — ResNet
-2048² bs2 OOMs on its GPUs, BASELINE.md) under ``rungs``, and
-``max_trainable_px`` — the largest square resolution that completes a bs1
-training step on one chip with remat+bf16, found by doubling + one midpoint
-refinement (each attempt in a subprocess so OOM cannot kill the benchmark).
+Memory-capability rungs (round 3): in addition to the 1024² headline (the
+no-remat rung, with a per-cell-remat fallback on OOM), the JSON's ``rungs``
+carry a 2048² bs1 measurement (the reference's OOM frontier — ResNet 2048²
+bs2 OOMs on its GPUs, BASELINE.md) and a 1024² bs2 measurement (the
+reference's best bs2 chart point), plus ``max_trainable_px`` — the largest
+square resolution that completes a bs1 training step on one chip with
+fine remat+bf16, found by doubling + one midpoint refinement (each attempt
+in a subprocess so OOM cannot kill the benchmark).
 
 Robustness: every measurement runs in a SUBPROCESS so a broken TPU plugin
 (the round-1 failure: axon init raised at jax.devices()) cannot kill the
@@ -454,18 +456,29 @@ def main() -> int:
         # Batch-2 rung at the flagship resolution (the reference's best bs2
         # chart point); no-remat first, remat fallback on OOM.
         print("[bench] 1024px bs2 rung", file=sys.stderr)
-        r_bs2, bs2_err = None, "skipped (bench deadline reached)"
+        import re as _re
+
+        r_bs2, bs2_errs = None, []
         for rm in ("none", "cell"):
             if _time_left() < 300:
+                bs2_errs.append(f"{rm}: skipped (bench deadline reached)")
                 break
-            r_bs2, bs2_err = _try_rung(
+            r_bs2, e = _try_rung(
                 "tpu_1024_bs2", "tpu", 1024, 18, 416, 1, 4,
                 min(1200, max(300, _time_left() - 300)), False, rm, 2,
             )
             if r_bs2 is not None:
                 break
+            bs2_errs.append(f"{rm}: {e}")
+            if not _re.search(
+                r"Ran out of memory|RESOURCE_EXHAUSTED|Out of memory", e or ""
+            ):
+                # Only OOM justifies the remat retry; a hang/backend failure
+                # would just burn the max-resolution probe's budget.
+                break
         headline["rungs"]["1024_bs2"] = _rung_summary(
-            r_bs2, bs2_err, BASELINE_1024_BS2, "vs_baseline_cluster_1024_bs2"
+            r_bs2, "; ".join(bs2_errs), BASELINE_1024_BS2,
+            "vs_baseline_cluster_1024_bs2",
         )
         # Max trainable resolution per chip (driver north-star metric).  The
         # 2048 rung above already proved (or failed) that resolution — seed

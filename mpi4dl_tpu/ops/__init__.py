@@ -4,10 +4,18 @@ from mpi4dl_tpu.ops.halo import (
     halo_exchange_with_mask,
     HaloSpec,
 )
+from mpi4dl_tpu.ops.ring import (
+    ghost_conv1d,
+    ring_attention,
+    seq_ghost_exchange,
+)
 
 __all__ = [
     "halo_exchange_1d",
     "halo_exchange_2d",
     "halo_exchange_with_mask",
     "HaloSpec",
+    "ghost_conv1d",
+    "ring_attention",
+    "seq_ghost_exchange",
 ]
