@@ -1,0 +1,191 @@
+"""Per-step communication volume of the compiled SPMD programs.
+
+Multi-chip hardware is not reachable from this environment, but the
+collectives XLA actually schedules are: this tool compiles a training step
+for each engine on a virtual mesh and reports, from the compiled HLO, the
+number of collective ops and the bytes they move per step — the
+compiler-derived counterpart of the reference's MPI message accounting
+(SURVEY §2a "comm backend" row; the reference exchanges per-conv halos via
+9-neighbour tagged p2p, per-stage activations via send/recv, and whole
+flat parameter buffers for GEMS MASTER-OPT).
+
+Collective classes counted: collective-permute (halo exchange, pipeline
+handoffs, GEMS mirror), all-reduce (DP gradients, cross-tile BN),
+all-gather / reduce-scatter / all-to-all (junctions, GSPMD resharding).
+
+Example (8 virtual devices):
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \\
+      python benchmarks/communication/comm_volume_report.py --image-size 64
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+sys.path.insert(
+    0,
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+)
+
+_COLLECTIVES = (
+    "collective-permute", "all-reduce", "all-gather", "reduce-scatter",
+    "all-to-all",
+)
+
+_DTYPE_BYTES = {
+    "f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4, "pred": 1,
+    "s8": 1, "u8": 1, "f64": 8, "s64": 8, "u64": 8,
+}
+
+
+def _tensor_bytes(shape_str: str) -> int:
+    """bytes of one HLO shape literal like 'bf16[2,16,16,8]{...}'."""
+    m = re.match(r"(\w+)\[([\d,]*)\]", shape_str)
+    if not m:
+        return 0
+    dt, dims = m.groups()
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n * _DTYPE_BYTES.get(dt, 4)
+
+
+def hlo_collective_stats(hlo_text: str) -> dict:
+    """Count collectives + bytes moved per class from compiled HLO text.
+
+    Counts each op once with its OUTPUT shape (for permutes/all-gathers the
+    received bytes; start/done pairs are deduplicated by counting only the
+    -start form when present)."""
+    stats = {k: {"count": 0, "bytes": 0} for k in _COLLECTIVES}
+    for line in hlo_text.splitlines():
+        s = line.strip()
+        m = re.match(
+            r"(?:ROOT\s+)?\S+\s*=\s*((?:\([^)]*\))|(?:\w+\[[^\]]*\]\S*))\s*"
+            r"(collective-permute|all-reduce|all-gather|reduce-scatter|"
+            r"all-to-all)(-start|-done)?\(", s)
+        if not m:
+            continue
+        shape_str, kind, phase = m.groups()
+        if phase == "-done":
+            continue  # counted at -start
+        if shape_str.startswith("("):
+            parts = [
+                t.strip() for t in shape_str[1:-1].split(",") if "[" in t
+            ]
+            if phase == "-start":
+                # Async start tuples are (operand, result[, contexts]) —
+                # one transfer; count the operand only, not both copies.
+                nbytes = _tensor_bytes(parts[0]) if parts else 0
+            else:
+                nbytes = sum(_tensor_bytes(t) for t in parts)
+        else:
+            nbytes = _tensor_bytes(shape_str)
+        stats[kind]["count"] += 1
+        stats[kind]["bytes"] += nbytes
+    stats["total_bytes"] = sum(
+        v["bytes"] for k, v in stats.items() if isinstance(v, dict)
+    )
+    stats["total_count"] = sum(
+        v["count"] for k, v in stats.items() if isinstance(v, dict)
+    )
+    return stats
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--image-size", type=int, default=64)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--halo-d2", action="store_true")
+    args = p.parse_args(argv)
+
+    import jax
+
+    # Pure host-side HLO analysis — always run on a deterministic 8-virtual-
+    # device CPU backend.  Must precede the first backend query (after
+    # jax.devices() these config updates no longer take effect).
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", 8)
+    except Exception as e:  # already initialized (e.g. under pytest)
+        if len(jax.devices()) < 8:
+            raise SystemExit(
+                "needs 8 devices: run with XLA_FLAGS="
+                "--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu"
+            ) from e
+
+    import jax.numpy as jnp
+
+    devices = jax.devices()[:8]
+
+    from mpi4dl_tpu.layer_ctx import SpatialCtx
+    from mpi4dl_tpu.mesh import MeshSpec, build_mesh
+    from mpi4dl_tpu.models.resnet import get_resnet_v2
+    from mpi4dl_tpu.parallel.partition import StagePartition
+    from mpi4dl_tpu.parallel.pipeline import (
+        init_pipeline_state, make_pipeline_train_step,
+    )
+    from mpi4dl_tpu.parallel.gems import make_gems_train_step
+    from mpi4dl_tpu.train import Optimizer, TrainState, make_spatial_train_step
+
+    px = args.image_size
+    bs = args.batch_size
+    model = get_resnet_v2((bs, px, px, 3), depth=11, num_classes=10)
+    params, _ = model.init(jax.random.key(0))
+    opt = Optimizer("sgd", lr=0.01)
+    report = {}
+
+    def compiled_text(step, *step_args):
+        return jax.jit(step).lower(*step_args).compile().as_text()
+
+    # SP: 4-tile vertical spatial step (per-conv D1 halos or fused D2)
+    sp = SpatialCtx(axis_w="spw", grid_w=4, d2_mode=args.halo_d2)
+    mesh_sp = build_mesh(MeshSpec(spw=4), devices[:4])
+    sstep = make_spatial_train_step(
+        model, opt, mesh_sp, sp, spatial_until=len(model.cells) - 1
+    )
+    state = TrainState.create(params, opt)
+    x = jnp.zeros((bs, px, px, 3), jnp.float32)
+    y = jnp.zeros((bs,), jnp.int32)
+    report["sp_4tile" + ("_d2" if args.halo_d2 else "")] = hlo_collective_stats(
+        compiled_text(sstep, state, x, y)
+    )
+
+    # PP: 4-stage GPipe pipeline, parts=2
+    mesh_pp = build_mesh(MeshSpec(stage=4), devices[:4])
+    part = StagePartition.build(model, params, 4, (1, px, px, 3))
+    pstep = make_pipeline_train_step(part, opt, mesh_pp, parts=2)
+    pstate = init_pipeline_state(part, params, opt, mesh_pp)
+    xp = jnp.zeros((2, px, px, 3), jnp.float32)
+    yp = jnp.zeros((2,), jnp.int32)
+    report["pp_4stage"] = hlo_collective_stats(
+        compiled_text(pstep, pstate, xp, yp)
+    )
+
+    # GEMS: bidirectional dual scan on the same 4-stage mesh
+    gstep = make_gems_train_step(part, opt, mesh_pp, parts=2, times=1)
+    gstate = init_pipeline_state(part, params, opt, mesh_pp)
+    xg = jnp.zeros((4, px, px, 3), jnp.float32)
+    yg = jnp.zeros((4,), jnp.int32)
+    report["gems_4stage"] = hlo_collective_stats(
+        compiled_text(gstep, gstate, xg, yg)
+    )
+
+    out = {
+        "metric": "per_step_collective_bytes",
+        "value": report[next(iter(report))]["total_bytes"],
+        "unit": "bytes",
+        "config": {"image_size": px, "batch_size": bs,
+                   "halo_d2": args.halo_d2},
+        "programs": report,
+    }
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -139,3 +139,28 @@ def test_rung_summary_shapes(bench):
         None, "skipped (bench deadline reached)", 2.95, "k"
     )
     assert skipped == {"error": "skipped (bench deadline reached)"}
+
+
+def test_hlo_collective_stats_parsing():
+    """comm_volume_report's HLO parser: counts each collective once (start
+    form preferred), sums output bytes, tuples summed per element."""
+    sys.path.insert(0, os.path.join(_REPO, "benchmarks", "communication"))
+    from comm_volume_report import hlo_collective_stats
+
+    hlo = """
+  %x = bf16[2,16,16,8]{3,2,1,0} collective-permute(%a), source_target_pairs={{0,1}}
+  %y = (f32[128]{0}, f32[128]{0}) all-reduce-start(%b, %c), replica_groups={}
+  %z = (f32[128]{0}, f32[128]{0}) all-reduce-done(%y)
+  ROOT %w = f32[64,4]{1,0} all-gather(%d), dimensions={1}
+  %notacoll = f32[8]{0} add(%e, %f)
+"""
+    s = hlo_collective_stats(hlo)
+    assert s["collective-permute"]["count"] == 1
+    assert s["collective-permute"]["bytes"] == 2 * 16 * 16 * 8 * 2
+    # async start tuple = (operand, result): ONE transfer, operand bytes
+    assert s["all-reduce"]["count"] == 1
+    assert s["all-reduce"]["bytes"] == 128 * 4
+    # ROOT-prefixed lines count too
+    assert s["all-gather"]["count"] == 1
+    assert s["all-gather"]["bytes"] == 64 * 4 * 4
+    assert s["total_count"] == 3
