@@ -62,8 +62,8 @@ _PEAKS = [
 # faster (no recompute forward); the remat rung is the OOM fallback and
 # the configuration of the memory rungs.
 LADDER = [
-    ("tpu_1024_noremat", "tpu", 1024, 18, 416, 2, 8, 1800, True, "none"),
-    ("tpu_1024", "tpu", 1024, 18, 416, 2, 8, 1800, True, "cell"),
+    ("tpu_1024_noremat", "tpu", 1024, 18, 416, 2, 12, 1800, True, "none"),
+    ("tpu_1024", "tpu", 1024, 18, 416, 2, 12, 1800, True, "cell"),
     ("tpu_512", "tpu", 512, 18, 416, 2, 8, 900, False, "cell"),
     ("cpu_smoke", "cpu", 128, 3, 64, 1, 3, 600, False, "cell"),
 ]

@@ -74,13 +74,19 @@ def hlo_collective_stats(hlo_text: str) -> dict:
         if phase == "-done":
             continue  # counted at -start
         if shape_str.startswith("("):
-            parts = [
-                t.strip() for t in shape_str[1:-1].split(",") if "[" in t
-            ]
+            # Array entries of the tuple (split(',') would break multi-dim
+            # shapes like bf16[2,16,16,8]).
+            parts = re.findall(r"\w+\[[\d,]*\]", shape_str)
             if phase == "-start":
                 # Async start tuples are (operand, result[, contexts]) —
-                # one transfer; count the operand only, not both copies.
-                nbytes = _tensor_bytes(parts[0]) if parts else 0
+                # one transfer; count the RESULT so async and sync forms of
+                # the same program report identical bytes (all-gather's
+                # result carries the group factor, reduce-scatter's the
+                # scattered shard — both matching their sync outputs).
+                nbytes = (
+                    _tensor_bytes(parts[1]) if len(parts) > 1
+                    else (_tensor_bytes(parts[0]) if parts else 0)
+                )
             else:
                 nbytes = sum(_tensor_bytes(t) for t in parts)
         else:

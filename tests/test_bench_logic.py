@@ -152,15 +152,19 @@ def test_hlo_collective_stats_parsing():
   %y = (f32[128]{0}, f32[128]{0}) all-reduce-start(%b, %c), replica_groups={}
   %z = (f32[128]{0}, f32[128]{0}) all-reduce-done(%y)
   ROOT %w = f32[64,4]{1,0} all-gather(%d), dimensions={1}
+  %v = (bf16[2,16,16,8]{3,2,1,0}, bf16[2,16,16,8]{3,2,1,0}, u32[], u32[]) collective-permute-start(%g)
+  %u = (f32[64]{0}, f32[256]{0}) all-gather-start(%h), dimensions={0}
   %notacoll = f32[8]{0} add(%e, %f)
 """
     s = hlo_collective_stats(hlo)
-    assert s["collective-permute"]["count"] == 1
-    assert s["collective-permute"]["bytes"] == 2 * 16 * 16 * 8 * 2
-    # async start tuple = (operand, result): ONE transfer, operand bytes
+    # sync permute + async permute-start (multi-dim tuple; result entry)
+    assert s["collective-permute"]["count"] == 2
+    assert s["collective-permute"]["bytes"] == 2 * (2 * 16 * 16 * 8 * 2)
+    # async start tuple = (operand, result): count the RESULT once
     assert s["all-reduce"]["count"] == 1
     assert s["all-reduce"]["bytes"] == 128 * 4
-    # ROOT-prefixed lines count too
-    assert s["all-gather"]["count"] == 1
-    assert s["all-gather"]["bytes"] == 64 * 4 * 4
-    assert s["total_count"] == 3
+    # ROOT-prefixed sync all-gather + async all-gather-start: both report
+    # the (group-factor-carrying) output bytes
+    assert s["all-gather"]["count"] == 2
+    assert s["all-gather"]["bytes"] == 64 * 4 * 4 + 256 * 4
+    assert s["total_count"] == 5
