@@ -1,7 +1,11 @@
 from mpi4dl_tpu.models.resnet import get_resnet_v1, get_resnet_v2, get_resnet
 from mpi4dl_tpu.models.amoebanet import amoebanetd
+from mpi4dl_tpu.models.seqblock import SeqBlock, make_seq_cp_train_step
 
-__all__ = ["get_resnet_v1", "get_resnet_v2", "get_resnet", "amoebanetd"]
+__all__ = [
+    "get_resnet_v1", "get_resnet_v2", "get_resnet", "amoebanetd",
+    "SeqBlock", "make_seq_cp_train_step",
+]
 
 
 def build_model(cfg):

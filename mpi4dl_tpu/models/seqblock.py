@@ -1,0 +1,127 @@
+"""Sequence-parallel transformer block — the long-context model family.
+
+The reference is a CNN framework; its long-context mechanism is spatial
+partitioning of the image "context" with ghost exchange (SURVEY §5).  This
+module is the 1-D model-level instance the TPU build adds on top of the
+same primitives: a pre-norm transformer block whose attention is EXACT
+ring attention over a sequence-sharded mesh axis (ops/ring.py — ppermute
+ring; Pallas flash local compute on TPU) and whose other ops are
+token-local, so the whole block trains under shard_map with ONLY the
+attention communicating.
+
+Functional style matching the rest of the package: ``init`` returns a
+params dict; ``apply(params, x, axis_name, n)`` runs replicated
+(``axis_name=None``) or sequence-sharded — one definition for both, the
+SpatialCtx dispatch idea carried to sequences.
+
+Layout: [B, T, D_model]; attention splits D_model into H heads.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from mpi4dl_tpu.ops.ring import ring_attention
+
+
+def _layer_norm(x, scale, bias, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + eps) * scale + bias
+    return y.astype(x.dtype)
+
+
+class SeqBlock:
+    """Pre-norm transformer block: LN → ring attention → +res → LN → MLP → +res.
+
+    ``heads`` must divide ``d_model``; MLP hidden = ``mlp_ratio * d_model``.
+    """
+
+    def __init__(self, d_model: int, heads: int, mlp_ratio: int = 4,
+                 causal: bool = True):
+        assert d_model % heads == 0, (d_model, heads)
+        self.d_model = d_model
+        self.heads = heads
+        self.d_head = d_model // heads
+        self.d_mlp = mlp_ratio * d_model
+        self.causal = causal
+
+    def init(self, key):
+        d, dm = self.d_model, self.d_mlp
+        ks = jax.random.split(key, 4)
+        s = 1.0 / (d ** 0.5)
+        sm = 1.0 / (dm ** 0.5)
+        return {
+            "ln1_scale": jnp.ones((d,), jnp.float32),
+            "ln1_bias": jnp.zeros((d,), jnp.float32),
+            "wqkv": jax.random.normal(ks[0], (d, 3 * d), jnp.float32) * s,
+            "wo": jax.random.normal(ks[1], (d, d), jnp.float32) * s,
+            "ln2_scale": jnp.ones((d,), jnp.float32),
+            "ln2_bias": jnp.zeros((d,), jnp.float32),
+            "w1": jax.random.normal(ks[2], (d, dm), jnp.float32) * s,
+            "b1": jnp.zeros((dm,), jnp.float32),
+            "w2": jax.random.normal(ks[3], (dm, d), jnp.float32) * sm,
+            "b2": jnp.zeros((d,), jnp.float32),
+        }
+
+    def apply(self, params, x, axis_name: Optional[str] = None, n: int = 1,
+              use_flash: Optional[bool] = None, interpret: bool = False):
+        """x: [B, T_local, D].  With ``axis_name`` the sequence is sharded
+        over that mesh axis (call inside shard_map); attention is the only
+        cross-device op (one ppermute ring per block)."""
+        b, t, d = x.shape
+        p = params
+        h = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
+        qkv = h @ p["wqkv"].astype(h.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shp = (b, t, self.heads, self.d_head)
+        att = ring_attention(
+            q.reshape(shp), k.reshape(shp), v.reshape(shp),
+            axis_name, n, causal=self.causal,
+            use_flash=use_flash, interpret=interpret,
+        ).reshape(b, t, d)
+        x = x + att @ p["wo"].astype(att.dtype)
+        h = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
+        h = jax.nn.gelu(h @ p["w1"].astype(h.dtype) + p["b1"].astype(h.dtype))
+        return x + h @ p["w2"].astype(h.dtype) + p["b2"].astype(x.dtype)
+
+
+def make_seq_cp_train_step(blocks, mesh, axis_name: str, n: int, lr: float,
+                           use_flash: Optional[bool] = None,
+                           interpret: bool = False):
+    """SGD training step for a stack of SeqBlocks under sequence (context)
+    parallelism: inputs/targets sharded [B, T/n, D] over ``axis_name``,
+    params replicated, grads psum'd over the ring.  Loss = mean squared
+    error to the target sequence (a stand-in head; the mechanism under
+    test is the CP schedule, which any loss shares)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, axis_name, None)
+
+    def loss_fn(params_list, x, y):
+        h = x
+        for blk, p in zip(blocks, params_list):
+            h = blk.apply(p, h, axis_name, n, use_flash, interpret)
+        err = (h - y).astype(jnp.float32)
+        return jax.lax.pmean(jnp.mean(err * err), axis_name)
+
+    def sharded_step(params_list, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params_list, x, y)
+        grads = jax.lax.pmean(grads, axis_name)
+        new = jax.tree.map(
+            lambda p, g: (p.astype(jnp.float32) - lr * g).astype(p.dtype),
+            params_list, grads,
+        )
+        return new, loss
+
+    return jax.jit(
+        shard_map(
+            sharded_step, mesh=mesh,
+            in_specs=(P(), spec, spec), out_specs=(P(), P()),
+        )
+    )
