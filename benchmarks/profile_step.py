@@ -1,0 +1,147 @@
+"""Capture + analyze an XProf trace of the headline training step.
+
+VERDICT r3 task 1: "nothing has yet been profiled at the op level on
+hardware".  This tool closes that: it builds the exact bench.py headline
+step (AmoebaNet-D(18,416), bf16, donate, configurable remat/batch/res),
+captures a ``jax.profiler`` trace of a few hot steps on the live chip, then
+parses the xplane protobuf with xprof's own converter and prints the top-N
+ops by self time — the evidence base for the MFU attack.
+
+Usage:
+    python benchmarks/profile_step.py --image-size 1024 --batch 1 \
+        --remat none --steps 5 --out /tmp/xprof_1024
+
+The analysis step also runs standalone on an existing trace dir:
+    python benchmarks/profile_step.py --analyze /tmp/xprof_1024
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import sys
+import time
+
+
+def capture(args) -> str:
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from bench import _build_step, _REMAT
+
+    dev = jax.devices()[0]
+    print(f"[profile] device={dev} kind={getattr(dev, 'device_kind', '?')}",
+          file=sys.stderr)
+
+    step, state = _build_step(
+        args.image_size, args.num_layers, args.num_filters, args.batch,
+        remat=_REMAT[args.remat],
+    )
+    xs = [
+        jax.random.normal(jax.random.key(100 + i),
+                          (args.batch, args.image_size, args.image_size, 3))
+        for i in range(2)
+    ]
+    ys = [jnp.full((args.batch,), i % 1000, jnp.int32) for i in range(2)]
+
+    t0 = time.perf_counter()
+    for i in range(2):
+        state, metrics = step(state, xs[i % 2], ys[i % 2])
+    float(metrics["loss"])
+    jax.block_until_ready(state)
+    print(f"[profile] compile+warmup {time.perf_counter() - t0:.1f}s",
+          file=sys.stderr)
+
+    os.makedirs(args.out, exist_ok=True)
+    jax.profiler.start_trace(args.out)
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        state, metrics = step(state, xs[i % 2], ys[i % 2])
+    float(metrics["loss"])
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+    jax.profiler.stop_trace()
+    print(f"[profile] {args.steps} steps in {dt:.2f}s "
+          f"({args.steps * args.batch / dt:.2f} img/s); trace -> {args.out}",
+          file=sys.stderr)
+    return args.out
+
+
+def _find_xplane(trace_dir: str) -> str | None:
+    pats = os.path.join(trace_dir, "**", "*.xplane.pb")
+    files = sorted(glob.glob(pats, recursive=True), key=os.path.getmtime)
+    return files[-1] if files else None
+
+
+def analyze(trace_dir: str, top: int = 30) -> None:
+    """Print per-op totals from the device plane of the xplane trace."""
+    xplane = _find_xplane(trace_dir)
+    if xplane is None:
+        print(f"[profile] no .xplane.pb under {trace_dir}", file=sys.stderr)
+        return
+    print(f"[profile] parsing {xplane}", file=sys.stderr)
+    from xprof.convert import raw_to_tool_data as rtd
+
+    params = {"use_saved_result": False}
+    data, _ = rtd.xspace_to_tool_data([xplane], "hlo_stats", params)
+    if isinstance(data, bytes):
+        data = data.decode("utf-8", "replace")
+    obj = json.loads(data) if isinstance(data, str) else data
+    # hlo_stats: list-of-dicts table ({p: columns, rows} varies by version).
+    rows = obj.get("rows") if isinstance(obj, dict) else obj
+    cols = [c.get("label") for c in obj.get("cols", [])] if isinstance(obj, dict) else None
+    if not rows or not cols:
+        out = os.path.join(trace_dir, "hlo_stats.json")
+        with open(out, "w") as f:
+            f.write(data if isinstance(data, str) else json.dumps(obj))
+        print(f"[profile] unrecognized hlo_stats layout; raw dump -> {out}",
+              file=sys.stderr)
+        return
+    idx = {c: i for i, c in enumerate(cols)}
+
+    def val(r, c):
+        return r["c"][idx[c]].get("v")
+
+    key = "Total self time (us)"
+    rows = sorted(rows, key=lambda r: -(val(r, key) or 0))
+    total = sum(val(r, key) or 0 for r in rows)
+    print(f"total device self time: {total / 1e3:.1f} ms")
+    for r in rows[:top]:
+        t = val(r, key) or 0
+        print(
+            f"{t / 1e3:8.2f} ms {100 * t / total:5.2f}% "
+            f"x{int(val(r, '#Occurrences') or 0):<3d} "
+            f"{val(r, 'HLO op category')}: {val(r, 'HLO op name')} "
+            f"bound={val(r, 'Bound by')}"
+        )
+        print("          ", (val(r, "HLO op text") or "")[:160].replace("\n", " "))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--image-size", type=int, default=1024)
+    ap.add_argument("--batch", type=int, default=1)
+    ap.add_argument("--num-layers", type=int, default=18)
+    ap.add_argument("--num-filters", type=int, default=416)
+    ap.add_argument("--remat", default="none", choices=["none", "cell", "fine"])
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--out", default="/tmp/xprof_step")
+    ap.add_argument("--analyze", default=None,
+                    help="skip capture; analyze this existing trace dir")
+    ap.add_argument("--top", type=int, default=30)
+    args = ap.parse_args()
+
+    if args.analyze:
+        analyze(args.analyze, args.top)
+        return 0
+    out = capture(args)
+    analyze(out, args.top)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -113,7 +113,8 @@ class Conv2d(Layer):
     def _pallas_dispatchable(sp, kh, kw, sh, sw, groups, kernel) -> bool:
         """Route this conv through the Pallas margin-consuming kernel?
         Stride 1, no groups, not 1x1 (a pure matmul XLA already handles),
-        weight slab within the VMEM cap in both directions."""
+        and the kernel's VMEM scratch within its caps in both directions —
+        the weight slab AND the th=1 input window (pallas_conv_eligible)."""
         if not (sp is not None and sp.use_pallas_conv):
             return False
         if (sh, sw) != (1, 1) or (kh, kw) == (1, 1) or groups != 1:
@@ -236,8 +237,9 @@ class BatchNorm(Layer):
         return params, in_shape
 
     def apply(self, params, x, ctx: ApplyCtx):
-        # Memory discipline (the 2048px→beyond lever, PERF_NOTES.md): never
-        # materialize an fp32 copy of the activation.  Statistics come from
+        # Memory discipline on the TRAIN path (the 2048px→beyond lever,
+        # PERF_NOTES.md; eval below trades it back for fp32 precision):
+        # never materialize an fp32 copy of the activation.  Statistics come from
         # ONE fused sum/sumsq pair with fp32 ACCUMULATION over the original
         # dtype (XLA fuses the upcast/square into the reductions), and
         # normalization is folded to y = x·a + b with per-channel fp32
@@ -279,7 +281,14 @@ class BatchNorm(Layer):
             if ctx.bn_sink is not None:
                 self._deposit_running(params, mean, var, cnt, ctx)
         else:
+            # Eval has no backward and therefore no activation-memory
+            # pressure — keep the affine in fp32 (ADVICE r3: the folded
+            # compute-dtype fma is a training-memory lever only; inference
+            # outputs keep full precision).
             mean, var = params["mean"], params["var"]
+            inv = lax.rsqrt(var + self.eps) * params["scale"]
+            y = x.astype(jnp.float32) * inv + (params["bias"] - mean * inv)
+            return y.astype(orig_dtype)
         inv = lax.rsqrt(var + self.eps) * params["scale"]
         a = inv.astype(orig_dtype)
         b = (params["bias"] - mean * inv).astype(orig_dtype)

@@ -97,7 +97,17 @@ def make_seq_cp_train_step(blocks, mesh, axis_name: str, n: int, lr: float,
     parallelism: inputs/targets sharded [B, T/n, D] over ``axis_name``,
     params replicated, grads psum'd over the ring.  Loss = mean squared
     error to the target sequence (a stand-in head; the mechanism under
-    test is the CP schedule, which any loss shares)."""
+    test is the CP schedule, which any loss shares).
+
+    Gradient form (ADVICE r3): the differentiated scalar is the GLOBAL mean
+    loss (pmean of the local shard means) and NOTHING touches the grads
+    afterwards — under vma-aware shard_map the cross-device grad reduction
+    is the transpose of that pmean's pbroadcast, so the grads come back
+    already replicated and correctly scaled.  The previous version applied
+    an extra ``lax.pmean`` to them: a silent no-op that would mis-scale by
+    1/n if the loss's internal pmean were ever removed (verified: switching
+    to local-loss + post-hoc pmean yields n-times-too-large gradients,
+    because the pbroadcast transpose psums the local-loss grads first)."""
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
@@ -112,7 +122,6 @@ def make_seq_cp_train_step(blocks, mesh, axis_name: str, n: int, lr: float,
 
     def sharded_step(params_list, x, y):
         loss, grads = jax.value_and_grad(loss_fn)(params_list, x, y)
-        grads = jax.lax.pmean(grads, axis_name)
         new = jax.tree.map(
             lambda p, g: (p.astype(jnp.float32) - lr * g).astype(p.dtype),
             params_list, grads,

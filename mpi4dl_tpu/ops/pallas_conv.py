@@ -105,21 +105,39 @@ _WSLAB_CAP = 8 * 1024 * 1024
 _DEFAULT_TCO = 128
 
 
+# Default W tile — shared with the eligibility gate's window math.
+_DEFAULT_TW = 128
+
+
 def _wslab_bytes(c: int, kh: int, kw: int, tco: int, itemsize: int) -> int:
     return kh * kw * _round_up(c, 128) * tco * itemsize
+
+
+def _win_bytes(c: int, kh: int, kw: int, th: int, tw: int, itemsize: int) -> int:
+    """Bytes of the [th + kh-1, round8(tw + kw-1), Cin_pad] input-window
+    scratch — the same formula the wrapper's H-tile shrink loop minimizes."""
+    return (th + kh - 1) * _round_up(tw + kw - 1, 8) * _round_up(c, 128) * itemsize
 
 
 def pallas_conv_eligible(cin: int, cout: int | None = None, kh: int = 3,
                          kw: int = 3, tco: int = _DEFAULT_TCO,
                          itemsize: int = 2) -> bool:
-    """True when the weight slab [kh, kw, Cin, tco] fits the VMEM cap — the
-    dispatch-time check mirroring the wrapper's trace-time error.  When
-    ``cout`` is given, the backward dx conv's io-swapped slab
-    [kh, kw, Cout, tco] must fit too (``_bwd`` runs the same kernel with
-    Cin/Cout exchanged)."""
-    ok = _wslab_bytes(cin, kh, kw, tco, itemsize) <= _WSLAB_CAP
+    """True when the kernel's VMEM scratch fits its caps — the dispatch-time
+    check mirroring the wrapper's trace-time errors.  Two bounds:
+
+    - weight slab [kh, kw, Cin, tco] within ``_WSLAB_CAP``; when ``cout`` is
+      given, the backward dx conv's io-swapped slab [kh, kw, Cout, tco] must
+      fit too (``_bwd`` runs the same kernel with Cin/Cout exchanged);
+    - input window within ``_WINDOW_BUDGET`` at the SMALLEST H tile (th=1) —
+      tall-kernel deep-Cin shapes (e.g. 7x1 at Cin ~4k) can pass the slab cap
+      yet have no fitting window, which previously surfaced as an opaque
+      Mosaic allocation error instead of a clean lax.conv fallback."""
+    ok = (
+        _wslab_bytes(cin, kh, kw, tco, itemsize) <= _WSLAB_CAP
+        and _win_bytes(cin, kh, kw, 1, _DEFAULT_TW, itemsize) <= _WINDOW_BUDGET
+    )
     if cout is not None:
-        ok = ok and _wslab_bytes(cout, kh, kw, tco, itemsize) <= _WSLAB_CAP
+        ok = ok and pallas_conv_eligible(cout, None, kh, kw, tco, itemsize)
     return ok
 
 
@@ -160,12 +178,21 @@ def halo_conv2d(
             f"kh*kw={kh * kw} exceeds the VMEM cap {_WSLAB_CAP} B — use "
             f"lax.conv for this layer (pallas_conv_eligible gates dispatch)"
         )
-    win_bytes = (
-        lambda t: (t + kh - 1) * _round_up(tw + kw - 1, 8) * cin_p
-        * x.dtype.itemsize
-    )
-    while th > 1 and win_bytes(th) > _WINDOW_BUDGET:
+    # Narrow images need no full-width W tile: clamping tw to the real width
+    # keeps deep-Cin narrow shapes inside the window budget (the gate stays
+    # conservative at tw=128 — it has no W — so dispatch merely declines
+    # them; direct callers get the capability).
+    tw = min(tw, max(wid, 8))
+    while th > 1 and _win_bytes(cin, kh, kw, th, tw, x.dtype.itemsize) > _WINDOW_BUDGET:
         th //= 2
+    if _win_bytes(cin, kh, kw, th, tw, x.dtype.itemsize) > _WINDOW_BUDGET:
+        raise ValueError(
+            f"pallas halo_conv2d: input window "
+            f"{_win_bytes(cin, kh, kw, th, tw, x.dtype.itemsize)} B at the "
+            f"minimum H tile (th={th}) for cin={cin} kh={kh} kw={kw} tw={tw} "
+            f"exceeds the VMEM window budget {_WINDOW_BUDGET} B — use "
+            f"lax.conv for this layer (pallas_conv_eligible gates dispatch)"
+        )
     cout_p = _round_up(cout, tco)
     h_p = _round_up(h, th)
     w_p = _round_up(wid, tw)
@@ -259,8 +286,8 @@ def _bwd(interpret, res, ct):
     # its output is exactly x's (padded-input) shape.
     ct_pad = jnp.pad(ct, ((0, 0), (kh - 1, kh - 1), (kw - 1, kw - 1), (0, 0)))
     w_t = jnp.flip(w, axis=(0, 1)).swapaxes(2, 3)
-    if _wslab_bytes(w_t.shape[2], kh, kw, _DEFAULT_TCO,
-                    ct.dtype.itemsize) <= _WSLAB_CAP:
+    if pallas_conv_eligible(w_t.shape[2], None, kh, kw, _DEFAULT_TCO,
+                            ct.dtype.itemsize):
         dx = halo_conv2d(
             ct_pad, w_t.astype(ct.dtype), out_dtype=x.dtype,
             interpret=_auto_interpret(interpret),

@@ -7,7 +7,9 @@ import os
 # The axon TPU plugin's sitecustomize imports jax at interpreter startup, so
 # env vars are already baked; use config updates (they win over the cached env
 # as long as no backend has been initialized yet).
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+# Stashed for the opt-in TPU-subprocess tests (MPI4DL_TPU_TESTS=1) before
+# the CPU pin below strips it from the inherited environment.
+_AXON_POOL_IPS = os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
@@ -40,3 +42,15 @@ def devices8():
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(0)
+
+
+@pytest.fixture(scope="session")
+def tpu_subprocess_env():
+    """Environment for an opt-in real-TPU subprocess: the axon pool config
+    restored, the CPU pin removed.  Tests using it must be gated on
+    MPI4DL_TPU_TESTS=1 (the tunnel is slow and intermittently down)."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    if _AXON_POOL_IPS is not None:
+        env["PALLAS_AXON_POOL_IPS"] = _AXON_POOL_IPS
+    return env
