@@ -43,6 +43,8 @@ BASELINE_CLUSTER = 2.1   # reference: AmoebaNet-D 1024² bs1, SP square + D2, 5 
 BASELINE_DEVICES = 5
 BASELINE_2048 = 2.85     # reference: AmoebaNet-D 2048² bs1, SP vertical + D2, 5 GPUs
 BASELINE_1024_BS2 = 2.95  # reference: AmoebaNet-D 1024² bs2, SP square + D2, 5 GPUs
+BASELINE_RESNET_1024 = 2.55  # reference: ResNet-110-v2 1024² bs1, SP best, 5 GPUs
+BASELINE_RESNET_2048 = 0.99  # reference: ResNet-110-v2 2048² bs1, SP, 5 GPUs
 
 # bf16 peak FLOP/s by TPU generation (public numbers); matched by substring of
 # jax.devices()[0].device_kind.  Used only for the mfu sanity check.
@@ -57,18 +59,20 @@ _PEAKS = [
 ]
 
 # (name, platform, image_size, num_layers, num_filters, warmup, iters,
-#  timeout_s, comparable, remat)
+#  timeout_s, comparable, remat, batch, scan)
 # The 1024² headline fits WITHOUT remat on a 16 GB chip and runs ~21%
 # faster (no recompute forward); the remat rung is the OOM fallback and
-# the configuration of the memory rungs.
+# the configuration of the memory rungs.  scan=6 packs 6 optimizer steps
+# per dispatch (axon RPC dispatch costs ~28 ms/step unamortized —
+# PERF_NOTES r4); warmup counts CALLS.
 LADDER = [
-    ("tpu_1024_noremat", "tpu", 1024, 18, 416, 2, 12, 1800, True, "none"),
-    ("tpu_1024", "tpu", 1024, 18, 416, 2, 12, 1800, True, "cell"),
-    ("tpu_512", "tpu", 512, 18, 416, 2, 8, 900, False, "cell"),
-    ("cpu_smoke", "cpu", 128, 3, 64, 1, 3, 600, False, "cell"),
+    ("tpu_1024_noremat", "tpu", 1024, 18, 416, 1, 18, 1800, True, "none", 1, 6),
+    ("tpu_1024", "tpu", 1024, 18, 416, 1, 18, 1800, True, "cell", 1, 6),
+    ("tpu_512", "tpu", 512, 18, 416, 1, 12, 900, False, "cell", 1, 6),
+    ("cpu_smoke", "cpu", 128, 3, 64, 1, 3, 600, False, "cell", 1, 1),
 ]
 
-_REMAT = {"none": False, "cell": True, "fine": "fine"}
+_REMAT = {"none": False, "cell": True, "fine": "fine", "sqrt": "sqrt"}
 
 PROBE_TIMEOUT_S = 1200
 # Global wall-clock budget: the memory rungs/probe stop (and the headline
@@ -97,26 +101,43 @@ def _peak_flops(device) -> float | None:
 
 
 def _build_step(image_size: int, num_layers: int, num_filters: int,
-                batch: int = 1, remat=True):
+                batch: int = 1, remat=True, scan: int = 1,
+                arch: str = "amoeba"):
     import jax
     import jax.numpy as jnp
 
-    from mpi4dl_tpu.models.amoebanet import amoebanetd
     from mpi4dl_tpu.train import Optimizer, TrainState, make_train_step
 
-    model = amoebanetd(
-        (batch, image_size, image_size, 3),
-        num_classes=1000,
-        num_layers=num_layers,
-        num_filters=num_filters,
-    )
+    if arch == "resnet":
+        # Memory-tuned remat grouping for the deep-thin model (PERF_NOTES
+        # r4: 16 groups beat sqrt(38)≈6 by ~2.2 GB at 2048²).
+        os.environ.setdefault("MPI4DL_SQRT_GROUPS", "16")
+        from mpi4dl_tpu.models.resnet import get_resnet_v2
+
+        # num_layers carries the depth for the ResNet rungs (110 = the
+        # reference's charted model, BASELINE.md).
+        model = get_resnet_v2(
+            (batch, image_size, image_size, 3),
+            depth=num_layers, num_classes=1000,
+        )
+    else:
+        from mpi4dl_tpu.models.amoebanet import amoebanetd
+
+        model = amoebanetd(
+            (batch, image_size, image_size, 3),
+            num_classes=1000,
+            num_layers=num_layers,
+            num_filters=num_filters,
+        )
     params, _ = model.init(jax.random.key(0))
     opt = Optimizer("sgd", lr=0.001)
     # bf16 compute + remat: per-cell (remat=True) for the throughput rungs;
     # per-op ("fine") for the max-resolution probes — backward temps bound
-    # to one op at a time.
+    # to one op at a time.  scan>1 packs k optimizer steps per dispatch
+    # (the dispatch-overhead amortization, PERF_NOTES r4).
     step = make_train_step(
-        model, opt, compute_dtype=jnp.bfloat16, remat=remat, donate=True
+        model, opt, compute_dtype=jnp.bfloat16, remat=remat, donate=True,
+        scan_steps=scan,
     )
     state = TrainState.create(params, opt)
     return step, state
@@ -163,7 +184,8 @@ def _measure(step, state, xs, ys, iters: int, blocked: bool):
 
 def _inner(platform: str, image_size: int, num_layers: int, num_filters: int,
            warmup: int, iters: int, comparable: bool,
-           remat="cell", batch: int = 1) -> None:
+           remat="cell", batch: int = 1, scan: int = 1,
+           arch: str = "amoeba") -> None:
     import jax
     import jax.numpy as jnp
 
@@ -180,19 +202,38 @@ def _inner(platform: str, image_size: int, num_layers: int, num_filters: int,
         sys.exit(3)
 
     step, state = _build_step(
-        image_size, num_layers, num_filters, batch, remat=_REMAT[remat]
+        image_size, num_layers, num_filters, batch, remat=_REMAT[remat],
+        scan=scan, arch=arch,
     )
+    # One timed "call" = `scan` optimizer steps compiled into one program
+    # (scan=1: the plain per-step dispatch).  iters counts optimizer steps.
+    calls = max(1, iters // scan)
+    iters = calls * scan
 
     # Fresh inputs: a small pool of distinct images cycled through the loop so
     # no iteration can be satisfied by a cached/constant-folded result.
-    n_inputs = min(4, max(2, iters))
+    n_inputs = min(4, max(2, calls))
+    shp = (batch, image_size, image_size, 3)
+    if scan > 1:
+        shp = (scan,) + shp
+    # bf16 input pool: the step casts to compute_dtype anyway, and fp32
+    # scan-stacked pools cost real HBM at the memory-frontier rungs
+    # (~300 MB at 2048² scan=3 — on rungs that miss fitting by ~250 MB).
     xs = [
-        jax.random.normal(jax.random.key(100 + i),
-                          (batch, image_size, image_size, 3))
+        jax.random.normal(jax.random.key(100 + i), shp, jnp.bfloat16)
         for i in range(n_inputs)
     ]
-    ys = [jnp.full((batch,), i % 1000, jnp.int32) for i in range(n_inputs)]
+    ys = [
+        jnp.full(shp[:-3], i % 1000, jnp.int32).reshape(
+            (scan, batch) if scan > 1 else (batch,)
+        )
+        for i in range(n_inputs)
+    ]
 
+    # XLA's HLO cost analysis counts a while/scan body ONCE (trip counts are
+    # not folded in) — verified empirically: the scanned program reports the
+    # same flops as the unscanned step (5.061e12 at 1024², r4).  So the
+    # reported number IS per-step; a call executes `scan` times that.
     flops = _step_flops(step, state, xs[0], ys[0])
     peak = _peak_flops(dev)
 
@@ -204,34 +245,36 @@ def _inner(platform: str, image_size: int, num_layers: int, num_filters: int,
     print(f"[bench] compile+warmup {time.perf_counter() - t_c:.1f}s; "
           f"flops/step={flops}", file=sys.stderr)
 
-    def mfu_of(dt: float, n_iters: int):
+    def mfu_of(dt: float, n_calls: int):
         if flops is None or peak is None:
             return None
-        return (flops * n_iters / dt) / peak
+        return (flops * scan * n_calls / dt) / peak
 
-    mode = "async_chain"
-    dt, state = _measure(step, state, xs, ys, iters, blocked=False)
-    mfu = mfu_of(dt, iters)
+    mode = "async_chain" if scan == 1 else f"scan{scan}_chain"
+    dt, state = _measure(step, state, xs, ys, calls, blocked=False)
+    mfu = mfu_of(dt, calls)
     error = None
     if mfu is not None and mfu > 1.0:
         # Physically impossible — the async timing did not capture the real
-        # work.  Re-measure with per-step blocking on the full state and more
+        # work.  Re-measure with per-call blocking on the full state and more
         # iterations; this cannot overcount.
         print(f"[bench] mfu={mfu:.2f} > 1 under async timing — "
               f"falling back to per-step blocking", file=sys.stderr)
         mode = "per_step_blocked"
-        iters = iters * 2
-        dt, state = _measure(step, state, xs, ys, iters, blocked=True)
-        mfu = mfu_of(dt, iters)
+        calls = calls * 2
+        iters = calls * scan
+        dt, state = _measure(step, state, xs, ys, calls, blocked=True)
+        mfu = mfu_of(dt, calls)
         if mfu is not None and mfu > 1.0:
             error = (f"measurement failed: mfu={mfu:.2f} > 1 even with "
                      f"per-step block_until_ready on the full state")
 
     img_per_sec = batch * iters / dt
-    achieved = (flops * iters / dt) if flops else None
+    achieved = (flops * scan * calls / dt) if flops else None
     ok = error is None
+    model_tag = "resnet110v2" if arch == "resnet" else "amoebanetd"
     out = {
-        "metric": f"amoebanetd_{image_size}px_bs{batch}_train_img_per_sec"
+        "metric": f"{model_tag}_{image_size}px_bs{batch}_train_img_per_sec"
                   "_single_chip_vs_5gpu_cluster_baseline",
         "value": round(img_per_sec, 4),
         "unit": "images/sec",
@@ -248,6 +291,7 @@ def _inner(platform: str, image_size: int, num_layers: int, num_filters: int,
         "device_kind": getattr(dev, "device_kind", None),
         "timing_mode": mode,
         "iters": iters,
+        "scan_steps_per_dispatch": scan,
         "flops_per_step": flops,
         "achieved_tflops": round(achieved / 1e12, 2) if achieved else None,
         "peak_tflops": round(peak / 1e12, 1) if peak else None,
@@ -327,10 +371,10 @@ def _run_sub(argv_tail, timeout_s, platform="tpu"):
 
 def _try_rung(name, platform, image_size, num_layers, num_filters,
               warmup, iters, timeout_s, comparable, remat="cell",
-              batch=1):
+              batch=1, scan=1, arch="amoeba"):
     tail = ["--inner", platform, str(image_size), str(num_layers),
             str(num_filters), str(warmup), str(iters),
-            "1" if comparable else "0", remat, str(batch)]
+            "1" if comparable else "0", remat, str(batch), str(scan), arch]
     result, err = _run_sub(tail, timeout_s, platform)
     if err:
         err = f"{name}: {err}"
@@ -350,7 +394,7 @@ def _rung_summary(result, err, baseline, baseline_key):
         "remat": result.get("remat"),
         baseline_key: (
             round(result["value"] / baseline, 4)
-            if not result.get("error") else None
+            if (baseline and not result.get("error")) else None
         ),
     }
     return out
@@ -401,8 +445,10 @@ def main() -> int:
         platform, image_size, num_layers, num_filters, warmup, iters, comp = sys.argv[2:9]
         remat = sys.argv[9] if len(sys.argv) > 9 else "cell"
         batch = int(sys.argv[10]) if len(sys.argv) > 10 else 1
+        scan = int(sys.argv[11]) if len(sys.argv) > 11 else 1
+        arch = sys.argv[12] if len(sys.argv) > 12 else "amoeba"
         _inner(platform, int(image_size), int(num_layers), int(num_filters),
-               int(warmup), int(iters), comp == "1", remat, batch)
+               int(warmup), int(iters), comp == "1", remat, batch, scan, arch)
         return 0
     if len(sys.argv) > 1 and sys.argv[1] == "--probe":
         _inner_probe(int(sys.argv[2]))
@@ -445,41 +491,70 @@ def main() -> int:
         # Memory-capability rung: the reference's OOM frontier (2048², bs1 —
         # its GPUs OOM at bs2 across all schemes, BASELINE.md).
         print("[bench] 2048px memory rung", file=sys.stderr)
+        # scan=1 on memory-frontier rungs: the scan-of-steps wrapper costs
+        # ~3.7 GB peak at 2048² (measured r4, unexplained — likely carry
+        # double-buffering), which a frontier rung cannot afford.
         r2048, err = _try_rung(
             "tpu_2048", "tpu", 2048, 18, 416, 1, 4,
-            min(1800, max(300, _time_left() - 300)), False,
+            min(1800, max(300, _time_left() - 300)), False, "cell", 1, 1,
         )
         headline["rungs"] = {
             "2048": _rung_summary(r2048, err, BASELINE_2048,
                                   "vs_baseline_cluster_2048"),
         }
-        # Batch-2 rung at the flagship resolution (the reference's best bs2
-        # chart point); no-remat first, remat fallback on OOM.
-        print("[bench] 1024px bs2 rung", file=sys.stderr)
+        # Batch-scaling rungs at the flagship resolution (VERDICT r3 task 2:
+        # the reference scales positively bs1→bs2; bs4/bs8 chart the curve).
+        # no-remat first, remat fallback on OOM.
         import re as _re
 
-        r_bs2, bs2_errs = None, []
-        for rm in ("none", "cell"):
-            if _time_left() < 300:
-                bs2_errs.append(f"{rm}: skipped (bench deadline reached)")
-                break
-            r_bs2, e = _try_rung(
-                "tpu_1024_bs2", "tpu", 1024, 18, 416, 1, 4,
-                min(1200, max(300, _time_left() - 300)), False, rm, 2,
+        for bname, bs, rung_scan in (
+            ("1024_bs2", 2, 4), ("1024_bs4", 4, 2), ("1024_bs8", 8, 1),
+        ):
+            print(f"[bench] 1024px bs{bs} rung", file=sys.stderr)
+            r_b, b_errs = None, []
+            for rm in ("none", "cell"):
+                if _time_left() < 300:
+                    b_errs.append(f"{rm}: skipped (bench deadline reached)")
+                    break
+                r_b, e = _try_rung(
+                    f"tpu_{bname}", "tpu", 1024, 18, 416, 1, 2 * bs * rung_scan,
+                    min(1200, max(300, _time_left() - 300)), False, rm, bs,
+                    rung_scan,
+                )
+                if r_b is not None:
+                    break
+                b_errs.append(f"{rm}: {e}")
+                if not _re.search(
+                    r"Ran out of memory|RESOURCE_EXHAUSTED|Out of memory", e or ""
+                ):
+                    # Only OOM justifies the remat retry; a hang/backend
+                    # failure would just burn the probes' budget.
+                    break
+            headline["rungs"][bname] = _rung_summary(
+                r_b, "; ".join(b_errs),
+                BASELINE_1024_BS2 if bs == 2 else None,
+                "vs_baseline_cluster_1024_bs2" if bs == 2 else "vs_baseline",
             )
-            if r_bs2 is not None:
-                break
-            bs2_errs.append(f"{rm}: {e}")
-            if not _re.search(
-                r"Ran out of memory|RESOURCE_EXHAUSTED|Out of memory", e or ""
-            ):
-                # Only OOM justifies the remat retry; a hang/backend failure
-                # would just burn the max-resolution probe's budget.
-                break
-        headline["rungs"]["1024_bs2"] = _rung_summary(
-            r_bs2, "; ".join(bs2_errs), BASELINE_1024_BS2,
-            "vs_baseline_cluster_1024_bs2",
-        )
+        # ResNet-110-v2 rungs — the reference's second charted model family
+        # (VERDICT r3 task 3).  1024² fits on the chip; the 2048² attempt is
+        # recorded honestly either way (as of r4 it misses the 16 GB HBM by
+        # ~250 MB after striping/packing/group-tuning — PERF_NOTES r4).
+        for rname, rpx, rscan, rbase in (
+            ("resnet_1024", 1024, 6, BASELINE_RESNET_1024),
+            ("resnet_2048", 2048, 1, BASELINE_RESNET_2048),  # frontier: scan=1
+        ):
+            if _time_left() < 300:
+                headline["rungs"][rname] = {"error": "bench deadline reached"}
+                continue
+            print(f"[bench] {rname} rung", file=sys.stderr)
+            r_rn, e_rn = _try_rung(
+                f"tpu_{rname}", "tpu", rpx, 110, 0, 1, 2 * rscan,
+                min(1200, max(300, _time_left() - 300)), False, "sqrt", 1,
+                rscan, "resnet",
+            )
+            headline["rungs"][rname] = _rung_summary(
+                r_rn, e_rn, rbase, f"vs_baseline_cluster_{rname}"
+            )
         # Max trainable resolution per chip (driver north-star metric).  The
         # 2048 rung above already proved (or failed) that resolution — seed
         # the ladder instead of re-compiling it.

@@ -132,26 +132,44 @@ class CellModel:
         stop = len(self.cells) if stop is None else stop
         if remat == "sqrt" and stop - start > 3:
             import math as _m
+            import os as _os
 
             n = stop - start
-            for lo, hi in split_even(n, max(2, _m.isqrt(n))):
+            # Group count: ~sqrt(n) balances outer boundaries against live
+            # inner boundaries; MPI4DL_SQRT_GROUPS overrides for memory
+            # tuning (bigger = smaller groups = fewer inner boundaries live
+            # during one group's backward).
+            g = int(_os.environ.get("MPI4DL_SQRT_GROUPS", "0")) or max(
+                2, _m.isqrt(n)
+            )
+            meta = None
+            for lo, hi in split_even(n, min(n, g)):
                 grp = tuple(range(start + lo, start + hi))
 
                 def grp_fn(ps, x, c, _grp=grp):
+                    m = None
                     for k, i in enumerate(_grp):
-                        x = _apply_cell_remat(self.cells[i], ps[k], x, c)
-                    return x
+                        x, m = checkpointed_apply(
+                            self.cells[i].apply, ps[k], x, c,
+                            in_meta=m, pack=True,
+                        )
+                    return _unpack_act(x, m)
 
-                x = checkpointed_apply(
-                    grp_fn, [params_list[i] for i in grp], x, ctx
+                x, meta = checkpointed_apply(
+                    grp_fn, [params_list[i] for i in grp], x, ctx,
+                    in_meta=meta, pack=True,
                 )
-            return x
+            return _unpack_act(x, meta)
+        meta = None
         for i in range(start, stop):
             if remat:
-                x = _apply_cell_remat(self.cells[i], params_list[i], x, ctx)
+                x, meta = checkpointed_apply(
+                    self.cells[i].apply, params_list[i], x, ctx,
+                    in_meta=meta, pack=True,
+                )
             else:
                 x = self.cells[i].apply(params_list[i], x, ctx)
-        return x
+        return _unpack_act(x, meta) if remat else x
 
     def out_shapes(self, params_list) -> List[ShapeLike]:
         """Abstract shape inference via eval_shape (no FLOPs, no memory)."""
@@ -165,7 +183,70 @@ class CellModel:
         return shapes
 
 
-def checkpointed_apply(apply_fn, params, x: Act, ctx: ApplyCtx) -> Act:
+# ---------------------------------------------------------------------------
+# Boundary channel-packing: tiny-channel huge-spatial checkpoint residuals.
+#
+# A [1, 2048, 2048, 64] bf16 boundary costs 1 GB on TPU — 2x its real size —
+# because any channels-minor layout pads C=64 to the 128-lane tile (and XLA's
+# backward temps for such shapes showed up in T(2,128) layouts padded 4-16x,
+# the measured ResNet-110 2048² OOM driver after conv temps were fixed,
+# PERF_NOTES r4).  Packing p = 128/C adjacent W pixels into channels makes
+# every saved residual (and its cotangent) an exactly-128-lane tensor with no
+# padding at all.  The pack/unpack reshapes live INSIDE the checkpoint, so
+# only the packed form is ever stored.  Shape-gated: huge-spatial only, C a
+# divisor of 128, W divisible by p — packs nothing otherwise (zero graph
+# change; AmoebaNet channels are all >= 128 and never pack).
+# ---------------------------------------------------------------------------
+
+_PACK_MIN_PIXELS = 1 << 20
+
+
+def _pack_meta(shape) -> Optional[Tuple[int, int]]:
+    if len(shape) != 4:
+        return None
+    n, h, w, c = shape
+    if c >= 128 or 128 % c or h * w < _PACK_MIN_PIXELS:
+        return None
+    p = 128 // c
+    if w % p:
+        return None
+    return (p, c)
+
+
+def _pack_one(x):
+    m = _pack_meta(getattr(x, "shape", ()))
+    if m is None:
+        return x, None
+    p, c = m
+    n, h, w, _ = x.shape
+    return x.reshape(n, h, w // p, p * c), m
+
+
+def _unpack_one(x, m):
+    if m is None:
+        return x
+    p, c = m
+    n, h, wp, _ = x.shape
+    return x.reshape(n, h, wp * p, c)
+
+
+def _pack_act(y: Act):
+    if isinstance(y, tuple):
+        pairs = [_pack_one(t) for t in y]
+        return tuple(p[0] for p in pairs), tuple(p[1] for p in pairs)
+    return _pack_one(y)
+
+
+def _unpack_act(y: Act, meta) -> Act:
+    if meta is None:
+        return y
+    if isinstance(y, tuple):
+        return tuple(_unpack_one(t, m) for t, m in zip(y, meta))
+    return _unpack_one(y, meta)
+
+
+def checkpointed_apply(apply_fn, params, x: Act, ctx: ApplyCtx,
+                       in_meta=None, pack: bool = False):
     """Run ``apply_fn(params, x, ctx)`` under jax.checkpoint.
 
     When a BN stats sink is active it must cross the checkpoint boundary
@@ -174,18 +255,32 @@ def checkpointed_apply(apply_fn, params, x: Act, ctx: ApplyCtx) -> Act:
     returns the stat updates aligned to the flattened param leaves, and they
     are re-deposited into the outer sink under the OUTER leaves' ids.
 
+    ``pack=True`` threads boundary channel-packing through the checkpoint:
+    ``x`` arrives in the packed form described by ``in_meta`` (unpacked
+    INSIDE the checkpointed fn) and the returned value is ``(y_packed,
+    out_meta)``.  The metas are static Python data captured at trace time.
+
     Serves the per-cell remat (model.apply remat=True) and the finer per-op
     remat inside AmoebaNet cells (ctx.remat_ops — the 'fine' level that
     bounds backward temps to one op's internals at a time; the
     max-trainable-resolution lever, PERF_NOTES.md)."""
     import dataclasses as _dc
 
+    out_meta = [None]
+
+    def body(p, x, c):
+        y = apply_fn(p, _unpack_act(x, in_meta) if pack else x, c)
+        if pack:
+            y, out_meta[0] = _pack_act(y)
+        return y
+
     if ctx.bn_sink is None:
-        return jax.checkpoint(lambda p, x: apply_fn(p, x, ctx))(params, x)
+        y = jax.checkpoint(lambda p, x: body(p, x, ctx))(params, x)
+        return (y, out_meta[0]) if pack else y
 
     def fn(p, x):
         inner: dict = {}
-        y = apply_fn(p, x, _dc.replace(ctx, bn_sink=inner))
+        y = body(p, x, _dc.replace(ctx, bn_sink=inner))
         stats = [inner.get(id(leaf)) for leaf in jax.tree.leaves(p)]
         return y, stats
 
@@ -193,11 +288,7 @@ def checkpointed_apply(apply_fn, params, x: Act, ctx: ApplyCtx) -> Act:
     for leaf, s in zip(jax.tree.leaves(params), stats):
         if s is not None:
             ctx.bn_sink[id(leaf)] = s
-    return y
-
-
-def _apply_cell_remat(cell: Cell, params, x: Act, ctx: ApplyCtx) -> Act:
-    return checkpointed_apply(cell.apply, params, x, ctx)
+    return (y, out_meta[0]) if pack else y
 
 
 def split_even(n_cells: int, split_size: int, balance: Optional[Sequence[int]] = None

@@ -33,6 +33,29 @@ from jax import lax
 from mpi4dl_tpu.layer_ctx import ApplyCtx, SpatialCtx
 from mpi4dl_tpu.ops.halo import HaloSpec, halo_exchange_2d, halo_exchange_with_mask
 
+# Escape hatches, read at DISPATCH time (trace), not import — so a script
+# can toggle them between step builds for A/B runs (the pattern bench.py
+# uses for MPI4DL_SQRT_GROUPS):
+#  MPI4DL_NO_PHASE_DX=1  — strided convs keep XLA's lhs-dilation backward
+#                          instead of ops/conv_phase.py.
+#  MPI4DL_NO_HSTRIPE=1   — tiny-channel huge-spatial convs keep the plain
+#                          XLA conv instead of ops/hstripe_conv.py.
+# Both wins are scheduling/layout properties of XLA's TPU lowering, not of
+# the math — hence the hatches.
+def _phase_dx_enabled() -> bool:
+    import os
+
+    return os.environ.get("MPI4DL_NO_PHASE_DX") != "1"
+
+
+def _hstripe_enabled() -> bool:
+    import os
+
+    return os.environ.get("MPI4DL_NO_HSTRIPE") != "1"
+
+
+_HSTRIPE_MIN_PIXELS = 1 << 20
+
 Params = Any
 Shape = Tuple[int, ...]
 
@@ -127,6 +150,27 @@ class Conv2d(Layer):
         )
 
     @staticmethod
+    def _hstripe_shape(kh, kw, sh, sw, groups, x) -> bool:
+        """Shape-based H-stripe dispatch for XLA-hostile convs: stride-1
+        small-kernel convs on TINY-channel HUGE-spatial inputs, where XLA's
+        TPU lowering materializes an im2col-style patch tensor (measured
+        ~3 GB per 3x3 conv at C=16, 2048² — the ResNet-110 high-resolution
+        OOM driver, PERF_NOTES r3/r4).  ops/hstripe_conv.py bounds the
+        temp by scanning H stripes.  (The Pallas kernel cannot take these
+        shapes: Mosaic refuses sub-128 lane DMA extents and a 128-lane
+        channel pad multiplies the input 8–42x in HBM — measured OOM.)
+        MPI4DL_NO_HSTRIPE=1 opts out."""
+        if not _hstripe_enabled():
+            return False
+        n, h, w, c = x.shape
+        # 1x1 convs are pure matmuls, but at huge spatial XLA still splits
+        # them with ~2x-padded GB-scale temps — striping bounds those too.
+        return (
+            (sh, sw) == (1, 1) and groups == 1
+            and c <= 64 and h * w >= _HSTRIPE_MIN_PIXELS
+        )
+
+    @staticmethod
     def _pallas_apply(params, x, kernel, pads, has_bias):
         from mpi4dl_tpu.ops.pallas_conv import halo_conv2d_t
 
@@ -184,14 +228,30 @@ class Conv2d(Layer):
                 params, x, kernel,
                 [(0, 0), padding[0], padding[1], (0, 0)], self.bias,
             )
-        y = lax.conv_general_dilated(
-            x,
-            kernel,
-            window_strides=(sh, sw),
-            padding=padding,
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            feature_group_count=self.feature_group_count,
-        )
+        if self._hstripe_shape(kh, kw, sh, sw, self.feature_group_count, x):
+            from mpi4dl_tpu.ops.hstripe_conv import hstripe_conv2d
+
+            y = hstripe_conv2d(x, kernel, padding[0], padding[1])
+            if self.bias:
+                y = y + params["bias"].astype(y.dtype)
+            return y
+        if ((sh, sw) != (1, 1) and self.feature_group_count == 1
+                and _phase_dx_enabled()):
+            # Strided convs take the phase-decomposed-backward form: same
+            # forward conv, but dx avoids XLA's lhs-dilation machinery
+            # (ops/conv_phase.py; measured step-level win, PERF_NOTES r4).
+            from mpi4dl_tpu.ops.conv_phase import conv2d_strided_t
+
+            y = conv2d_strided_t(x, kernel, (sh, sw), padding)
+        else:
+            y = lax.conv_general_dilated(
+                x,
+                kernel,
+                window_strides=(sh, sw),
+                padding=padding,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                feature_group_count=self.feature_group_count,
+            )
         if self.bias:
             y = y + params["bias"].astype(y.dtype)
         return y
@@ -393,23 +453,58 @@ class Flatten(Layer):
 def _window_reduce(x, kh, kw, sh, sw, ph, pw, op: str):
     """Differentiable window reduction (max/add) over NHWC.
 
-    Non-overlapping unpadded windows use a reshape; otherwise the k*k shifted
-    strided slices are reduced elementwise (k ≤ 8 here, so ≤ 64 fused ops).
+    Non-overlapping unpadded windows use a reshape.  STRIDED overlapping
+    windows use a phase decomposition: pad, reshape H→(H/s, s) W→(W/s, s),
+    and read every tap as a UNIT-stride slice ``y[:, i//s : i//s + oh, i % s,
+    ...]`` — on TPU a stride-s slice lowers to gathers in the forward and
+    chained pad-scatter fusions in the backward (measured the single largest
+    self-inflicted cost class of the AmoebaNet step at 1024²: ~9 ms of
+    forward gathers + ~25 ms of scatter chains per 244 ms step, PERF_NOTES
+    r4), while unit-stride slices of the phase view fuse into plain loop
+    fusions with pad transposes.  Stride-1 windows keep the direct shifted
+    slices (k ≤ 8 here, so ≤ 64 fused ops).
     """
     n, h, w, c = x.shape
     if ph == 0 and pw == 0 and kh == sh and kw == sw and h % kh == 0 and w % kw == 0:
         r = x.reshape(n, h // kh, kh, w // kw, kw, c)
         return jnp.max(r, axis=(2, 4)) if op == "max" else jnp.sum(r, axis=(2, 4))
+    fill = jnp.asarray(-jnp.inf if op == "max" else 0, x.dtype)
+    oh = (h + 2 * ph - kh) // sh + 1
+    ow = (w + 2 * pw - kw) // sw + 1
+    if sh > 1 or sw > 1:
+        # Phase view: padded row b = q·s + φ ↦ y[..., q, φ, ...].  Tap i of
+        # output q reads padded row q·s + i = (q + i//s)·s + (i % s): a
+        # unit-stride slice at phase i % s, offset i//s.  Rows/cols are
+        # padded up to the phase grid; taps never read past (oh-1)·s + k-1,
+        # so the grid crop below is safe for any h, s, k.
+        hr = oh + (kh - 1) // sh
+        wr = ow + (kw - 1) // sw
+        xp = jnp.pad(
+            x,
+            ((0, 0), (ph, max(0, hr * sh - h - ph)),
+             (pw, max(0, wr * sw - w - pw)), (0, 0)),
+            constant_values=fill,
+        )
+        y = xp[:, : hr * sh, : wr * sw, :].reshape(n, hr, sh, wr, sw, c)
+        acc = None
+        for i in range(kh):
+            for j in range(kw):
+                piece = y[:, i // sh : i // sh + oh, i % sh,
+                          j // sw : j // sw + ow, j % sw, :]
+                if acc is None:
+                    acc = piece
+                elif op == "max":
+                    acc = jnp.maximum(acc, piece)
+                else:
+                    acc = acc + piece
+        return acc
     if ph or pw:
-        fill = jnp.asarray(-jnp.inf if op == "max" else 0, x.dtype)
         x = jnp.pad(x, ((0, 0), (ph, ph), (pw, pw), (0, 0)), constant_values=fill)
         h, w = h + 2 * ph, w + 2 * pw
-    oh = (h - kh) // sh + 1
-    ow = (w - kw) // sw + 1
     acc = None
     for i in range(kh):
         for j in range(kw):
-            piece = x[:, i : i + (oh - 1) * sh + 1 : sh, j : j + (ow - 1) * sw + 1 : sw, :]
+            piece = x[:, i : i + oh, j : j + ow, :]
             if acc is None:
                 acc = piece
             elif op == "max":
@@ -490,7 +585,14 @@ class Pool2d(Layer):
             rem_ph = 0 if sharded_h else ph
             rem_pw = 0 if sharded_w else pw
         else:
-            mask = jnp.ones(x.shape[:-1] + (1,), x.dtype) if need_mask else None
+            # Unsharded max needs no mask: _window_reduce pads with -inf
+            # itself, and a where() against an all-ones mask is a full
+            # activation pass for nothing.  Avg keeps it for the in-bounds
+            # divisor (a constant XLA folds away).
+            mask = (
+                jnp.ones(x.shape[:-1] + (1,), x.dtype)
+                if (need_mask and self.op == "avg") else None
+            )
             rem_ph, rem_pw = ph, pw
 
         # NOTE: implemented with shifted-slice reductions rather than

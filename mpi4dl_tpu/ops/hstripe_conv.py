@@ -1,0 +1,104 @@
+"""H-striped convolution — bounding XLA's conv temporaries at huge spatial.
+
+XLA's TPU lowering of a stride-1 conv on a TINY-channel HUGE-spatial input
+materializes an im2col-style patch tensor of ~kh·kw·H·W·C elements
+(measured ~3 GB per 3x3 conv at C=16, 2048² — the single reason
+ResNet-110-v2 2048² bs1 did not fit a 16 GB chip, PERF_NOTES r3; the
+reference sidesteps it only because cuDNN has native strided kernels and
+its SP mode splits H/W across 5 GPUs, `/root/reference/src/torchgems/
+spatial.py`).  The Pallas margin-consuming kernel cannot take these shapes
+either: Mosaic refuses sub-128 lane DMA extents, and padding C=3..16 up to
+128 lanes multiplies the whole input in HBM (8–42x, measured OOM).
+
+So: run the conv as a ``lax.map`` (serial scan) over H stripes.  Each
+stripe is a VALID conv on ``[N, sh + kh - 1, W', C]`` — the patch temp
+shrinks by the stripe count and is freed before the next stripe runs.  The
+backward (scan transpose) accumulates stripe input-grads with contiguous
+``dynamic_update_slice``s — no scatter.  FLOPs are identical; only peak
+memory changes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_DIMNUMS = ("NHWC", "HWIO", "NHWC")
+
+# Per-stripe im2col budget (bytes).  Stripe count is the smallest divisor
+# of the output height whose stripe patch tensor fits the budget.
+_PATCH_BUDGET = 192 * 1024 * 1024
+
+
+def _pick_stripes(h: int, wid: int, cin: int, kh: int, kw: int,
+                  itemsize: int) -> int:
+    patch = h * wid * cin * kh * kw * itemsize
+    if patch <= _PATCH_BUDGET:
+        return 1
+    want = -(-patch // _PATCH_BUDGET)
+    for s in range(want, h + 1):
+        if h % s == 0:
+            return s
+    return h
+
+
+def hstripe_conv2d(x: jax.Array, w: jax.Array,
+                   pad_h=(0, 0), pad_w=(0, 0)) -> jax.Array:
+    """Stride-1 conv with explicit padding, H stripe by H stripe.
+
+    x: [N, H, W, Cin]; w: [kh, kw, Cin, Cout] →
+    [N, H + Σpad_h − kh + 1, W + Σpad_w − kw + 1, Cout].
+
+    Layout discipline (the actual ResNet-110 2048² OOM fix, PERF_NOTES r4):
+    a full-size tiny-C 4-D tensor adjacent to a conv gets XLA's
+    narrow-channel conv layouts — T(2,128) padded 4–16x at C=16..64 — so NO
+    full-size 4-D tensor may exist here.  The input is flattened to
+    [N, H, W·C] (fusible into its producer, so the producer's output buffer
+    is the cleanly-tiled flat form), H padding happens on flat rows, W
+    padding happens INSIDE the per-stripe conv, and each stripe reshapes to
+    4-D only transiently.  The backward inherits all of it: the scan
+    transpose accumulates dx into the flat buffer.
+
+    Differentiable through the scan (dx = per-stripe conv-transposes
+    assembled by dynamic_update_slice; dw = accumulated stripe filter
+    grads).  Two variants were tried and measured WORSE on the ResNet-110
+    2048² peak: a custom VJP saving (x, w) whole with explicitly re-striped
+    dx/dw (+2 GB — the full-x residual and padded-cotangent buffer outlive
+    the scan), and a fully-flat form that skipped the 4-D W-pad by padding
+    W inside each stripe's conv (+2.8 GB — whatever fusion XLA lost there
+    cost more than the pad copy).  Measured best: pad the 4-D input once,
+    flatten, stripe."""
+    n, h, wid, cin = x.shape
+    kh, kw, wcin, cout = w.shape
+    assert wcin == cin, (wcin, cin)
+    (phl, phh), (pwl, pwh) = pad_h, pad_w
+    oh = h + phl + phh - (kh - 1)
+    ow = wid + pwl + pwh - (kw - 1)
+    stripes = _pick_stripes(oh, wid + pwl + pwh, cin, kh, kw,
+                            x.dtype.itemsize)
+    if stripes == 1:
+        return lax.conv_general_dilated(
+            x, w, (1, 1), (pad_h, pad_w), dimension_numbers=_DIMNUMS
+        )
+    sh = oh // stripes
+
+    # Pads happen on the 4-D form, THEN the tensor flattens.  A fully-flat
+    # variant (W pad as pw·C elements on the flat last dim) was also tried
+    # and measured +2.8 GB worse — XLA's fusion/layout choices around the
+    # flat pad were worse than one 4-D pad copy.  Empirical, not modeled.
+    if phl or phh or pwl or pwh:
+        x = jnp.pad(x, ((0, 0), (phl, phh), (pwl, pwh), (0, 0)))
+    hp, wp = h + phl + phh, wid + pwl + pwh
+    xf = x.reshape(n, hp, wp * cin)
+
+    def piece(i):
+        xs = lax.dynamic_slice_in_dim(xf, i * sh, sh + kh - 1, axis=1)
+        y = lax.conv_general_dilated(
+            xs.reshape(n, sh + kh - 1, wp, cin), w, (1, 1), "VALID",
+            dimension_numbers=_DIMNUMS,
+        )
+        return y.reshape(n, sh, ow * cout)
+
+    ys = lax.map(piece, jnp.arange(stripes))        # [S, N, sh, OW·Cout]
+    return ys.transpose(1, 0, 2, 3).reshape(n, oh, ow, cout)

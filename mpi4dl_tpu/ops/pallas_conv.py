@@ -109,14 +109,25 @@ _DEFAULT_TCO = 128
 _DEFAULT_TW = 128
 
 
+def _cpad(c: int) -> int:
+    """Channel padding target: the 128-lane width, always.  A sub-128 pad
+    was tried for the tiny-channel huge-spatial regime (ResNet C∈{3,16}) and
+    REJECTED by Mosaic on hardware: a DMA window slice of a sub-128 channel
+    extent lowers to a lane-dim memref_slice, which Mosaic refuses (both for
+    the input window and the weight slab).  Tiny-channel shapes therefore
+    must NOT take this kernel (the 128-pad multiplies the whole input in
+    HBM — 42.7x for C=3); they use ops/hstripe_conv.py instead."""
+    return _round_up(c, 128)
+
+
 def _wslab_bytes(c: int, kh: int, kw: int, tco: int, itemsize: int) -> int:
-    return kh * kw * _round_up(c, 128) * tco * itemsize
+    return kh * kw * _cpad(c) * tco * itemsize
 
 
 def _win_bytes(c: int, kh: int, kw: int, th: int, tw: int, itemsize: int) -> int:
     """Bytes of the [th + kh-1, round8(tw + kw-1), Cin_pad] input-window
     scratch — the same formula the wrapper's H-tile shrink loop minimizes."""
-    return (th + kh - 1) * _round_up(tw + kw - 1, 8) * _round_up(c, 128) * itemsize
+    return (th + kh - 1) * _round_up(tw + kw - 1, 8) * _cpad(c) * itemsize
 
 
 def pallas_conv_eligible(cin: int, cout: int | None = None, kh: int = 3,
@@ -170,7 +181,7 @@ def halo_conv2d(
     assert h > 0 and wid > 0, (x.shape, w.shape)
     out_dtype = out_dtype or x.dtype
 
-    cin_p = _round_up(cin, 128)
+    cin_p = _cpad(cin)
     wslab = _wslab_bytes(cin, kh, kw, tco, w.dtype.itemsize)
     if wslab > _WSLAB_CAP:
         raise ValueError(

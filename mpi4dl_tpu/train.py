@@ -192,8 +192,17 @@ def make_train_step(
     bn_stats: bool = True,
     donate: bool = False,
     pallas_conv: bool = False,
+    scan_steps: int = 1,
 ):
     """Single-device or DP (batch sharded over 'data') training step.
+
+    ``scan_steps=k`` returns a MULTI-step function ``(state, xs, ys) ->
+    (state, metrics)`` with ``xs: [k, B, H, W, C]`` running k optimizer
+    steps in ONE compiled program (lax.scan; metrics averaged over the
+    scan).  Under the axon RPC tunnel each dispatch costs ~28 ms of
+    non-device time (PERF_NOTES r4) — k steps per dispatch amortizes it
+    to ~0, which is also how a real training loop would drive the chip.
+    Single-device only (the stacked-batch shardings are not plumbed).
 
     `parts` > 1 runs the micro-batch gradient-accumulation loop via lax.scan —
     the degenerate (split_size=1) form of the reference's GPipe parts loop.
@@ -271,6 +280,16 @@ def make_train_step(
             {"loss": loss, "accuracy": acc},
         )
 
+    if scan_steps > 1 and mesh is not None:
+        raise ValueError("scan_steps>1 is single-device only")
+    if scan_steps > 1:
+        def multi(state: TrainState, xs, ys):
+            state, ms = lax.scan(
+                lambda s, xy: step(s, xy[0], xy[1]), state, (xs, ys)
+            )
+            return state, jax.tree.map(lambda a: jnp.mean(a), ms)
+
+        return jax.jit(multi, donate_argnums=(0,) if donate else ())
     if mesh is None:
         # donate=True consumes the caller's state (params/opt buffers update
         # in place), removing a full extra copy of params+opt from peak

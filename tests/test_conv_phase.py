@@ -1,0 +1,114 @@
+"""Phase-decomposed strided-conv backward (ops/conv_phase.py) and the
+phase-view strided _window_reduce (layers.py) vs plain XLA — values AND
+gradients must match the un-decomposed forms."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+
+from mpi4dl_tpu.ops.conv_phase import conv2d_strided_t
+
+
+def _lax_conv(x, w, strides, padding):
+    return lax.conv_general_dilated(
+        x, w, strides, padding, dimension_numbers=("NHWC", "HWIO", "NHWC")
+    )
+
+
+@pytest.mark.parametrize(
+    "h,w,kh,kw,sh,sw,ph,pw",
+    [
+        (16, 16, 3, 3, 2, 2, 1, 1),   # the reduction-cell conv shape class
+        (16, 16, 1, 1, 2, 2, 0, 0),   # FactorizedReduce halves
+        (17, 15, 3, 3, 2, 2, 1, 1),   # odd sizes: trailing rows unread
+        (16, 16, 1, 7, 1, 2, 0, 3),   # 1x7 with stride on W only
+        (16, 16, 7, 1, 2, 1, 3, 0),   # 7x1 with stride on H only
+        (15, 15, 5, 5, 3, 3, 2, 2),   # s=3: phases of unequal sub-kernel len
+        (16, 16, 2, 2, 2, 2, 0, 0),   # max_pool_2x2-like geometry, conv case
+        (14, 14, 3, 3, 2, 2, 0, 0),   # no padding
+    ],
+)
+def test_conv2d_strided_t_matches_lax(h, w, kh, kw, sh, sw, ph, pw):
+    k1, k2, k3 = jax.random.split(jax.random.key(0), 3)
+    cin, cout = 8, 12
+    x = jax.random.normal(k1, (2, h, w, cin), jnp.float32)
+    wk = jax.random.normal(k2, (kh, kw, cin, cout), jnp.float32) / (kh * kw)
+    strides, padding = (sh, sw), ((ph, ph), (pw, pw))
+
+    y = conv2d_strided_t(x, wk, strides, padding)
+    y_ref = _lax_conv(x, wk, strides, padding)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), atol=1e-5)
+
+    t = jax.random.normal(k3, y.shape, jnp.float32)
+    gx, gw = jax.grad(
+        lambda x, w_: jnp.sum(conv2d_strided_t(x, w_, strides, padding) * t),
+        argnums=(0, 1),
+    )(x, wk)
+    gx_r, gw_r = jax.grad(
+        lambda x, w_: jnp.sum(_lax_conv(x, w_, strides, padding) * t),
+        argnums=(0, 1),
+    )(x, wk)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(gx_r), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(gw_r), atol=1e-4)
+
+
+def test_conv2d_strided_t_asymmetric_padding():
+    k1, k2 = jax.random.split(jax.random.key(1))
+    x = jax.random.normal(k1, (1, 13, 11, 4), jnp.float32)
+    wk = jax.random.normal(k2, (3, 3, 4, 6), jnp.float32) / 9
+    strides, padding = (2, 2), ((1, 2), (0, 1))
+    y = conv2d_strided_t(x, wk, strides, padding)
+    y_ref = _lax_conv(x, wk, strides, padding)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), atol=1e-5)
+    gx = jax.grad(lambda x: jnp.sum(conv2d_strided_t(x, wk, strides, padding) ** 2))(x)
+    gx_r = jax.grad(lambda x: jnp.sum(_lax_conv(x, wk, strides, padding) ** 2))(x)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(gx_r), atol=1e-4)
+
+
+@pytest.mark.parametrize("op", ["max", "avg"])
+@pytest.mark.parametrize(
+    "h,w,k,s,p",
+    [
+        (16, 16, 3, 2, 1),   # reduction-cell pools
+        (15, 17, 3, 2, 1),   # odd sizes
+        (16, 16, 2, 2, 0),   # max_pool_2x2
+        (16, 16, 3, 1, 1),   # stride-1 control (old path)
+        (12, 12, 5, 3, 2),   # k > s, s > 2
+    ],
+)
+def test_pool_phase_matches_torch_semantics(op, h, w, k, s, p):
+    """Pool2d forward + grad vs torch (the reference's nn.MaxPool2d /
+    nn.AvgPool2d(count_include_pad=False) semantics)."""
+    import torch
+    import torch.nn.functional as F
+
+    from mpi4dl_tpu.layer_ctx import ApplyCtx
+    from mpi4dl_tpu.layers import Pool2d
+
+    x = jax.random.normal(jax.random.key(2), (2, h, w, 5), jnp.float32)
+    pool = Pool2d(op, k, s, p) if op == "max" else Pool2d(
+        op, k, s, p, count_include_pad=False
+    )
+    ctx = ApplyCtx(train=True)
+
+    def f(x):
+        return pool.apply({}, x, ctx)
+
+    y, vjp = jax.vjp(f, x)
+    t = jax.random.normal(jax.random.key(3), y.shape, jnp.float32)
+    (gx,) = vjp(t)
+
+    xt = torch.tensor(np.asarray(x).transpose(0, 3, 1, 2), requires_grad=True)
+    if op == "max":
+        yt = F.max_pool2d(xt, k, s, p)
+    else:
+        yt = F.avg_pool2d(xt, k, s, p, count_include_pad=False)
+    yt.backward(torch.tensor(np.asarray(t).transpose(0, 3, 1, 2)))
+    np.testing.assert_allclose(
+        np.asarray(y), yt.detach().numpy().transpose(0, 2, 3, 1), atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(gx), xt.grad.numpy().transpose(0, 2, 3, 1), atol=1e-5
+    )
