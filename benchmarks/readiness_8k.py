@@ -1,0 +1,184 @@
+"""8K x 8K readiness artifact (VERDICT r3 task 7).
+
+The driver's north-star (`BASELINE.json`) is AmoebaNet-D at 8192x8192 under
+SP+PP on a v5p-32.  That hardware is unreachable from this environment, but
+two of the three questions it poses are answerable today:
+
+1. **Does the flagship program COMPILE at the real shapes?**  This tool
+   builds the SP(4x4) x PP(2) training step for AmoebaNet-D(18,416) at
+   8192² bs1 on a 32-virtual-device CPU mesh and compiles it — XLA
+   partitions, inserts the collectives, and assigns buffers exactly as it
+   would for a real 32-device slice (CPU layouts, i.e. no TPU tile
+   padding — stated with the numbers).
+2. **What moves per step?**  Collective counts/bytes are read from the
+   compiled HLO at the REAL shapes (the existing comm_volume_report runs at
+   64² toy shapes), via the same parser.
+3. **Does it fit?**  Per-device HBM demand = the compiled module's
+   temp+argument+output sizes (SPMD: the module IS the per-device program)
+   plus an analytic eval_shape activation ledger as a cross-check, compared
+   against per-chip HBM of v5p (95 GB) and v5e (16 GB).
+
+Usage (self-provisions the virtual mesh):
+    python benchmarks/readiness_8k.py [--image-size 8192] [--tiles 4]
+        [--stages 2] [--parts 1] [--out /tmp/readiness.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+V5P_HBM_GB = 95.0
+V5E_HBM_GB = 16.0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--image-size", type=int, default=8192)
+    p.add_argument("--tiles", type=int, default=4, help="spatial grid per dim")
+    p.add_argument("--stages", type=int, default=2)
+    p.add_argument("--parts", type=int, default=1)
+    p.add_argument("--num-layers", type=int, default=18)
+    p.add_argument("--num-filters", type=int, default=416)
+    p.add_argument("--spatial-until", type=int, default=9,
+                   help="cells in the spatial region (stems + first normal "
+                        "group by default — the high-resolution cells)")
+    p.add_argument("--out", default=None)
+    args = p.parse_args(argv)
+
+    n_dev = args.tiles * args.tiles * args.stages
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", n_dev)
+    except Exception as e:
+        if len(jax.devices()) < n_dev:
+            raise SystemExit(f"needs {n_dev} devices (got {len(jax.devices())})") from e
+
+    import jax.numpy as jnp
+
+    from benchmarks.communication.comm_volume_report import hlo_collective_stats
+    from mpi4dl_tpu.layer_ctx import SpatialCtx
+    from mpi4dl_tpu.mesh import MeshSpec, build_mesh
+    from mpi4dl_tpu.models.amoebanet import amoebanetd
+    from mpi4dl_tpu.parallel.sp_pipeline import (
+        SPPipeline, init_sp_pipeline_state, make_sp_pipeline_train_step,
+    )
+    from mpi4dl_tpu.train import Optimizer
+
+    px, t, S = args.image_size, args.tiles, args.stages
+    model = amoebanetd(
+        (1, px, px, 3), num_classes=1000,
+        num_layers=args.num_layers, num_filters=args.num_filters,
+    )
+    model.spatial_until = min(args.spatial_until, len(model.cells) - 1)
+    params, shapes = model.init(jax.random.key(0))
+
+    # --- analytic ledger: per-device activation bytes from eval_shape ----
+    # Spatial cells carry H/t x W/t tiles; tail cells live on one stage.
+    su = model.spatial_until
+    ledger = {"spatial_cells": [], "tail_cells": []}
+    for i, shp in enumerate(shapes):
+        shps = shp if isinstance(shp[0], tuple) else (shp,)
+        bytes_dev = 0
+        for s in shps:
+            n = 1
+            for d in s:
+                n *= d
+            if i < su:
+                n //= t * t
+            bytes_dev += n * 2  # bf16
+        (ledger["spatial_cells"] if i < su else ledger["tail_cells"]).append(
+            {"cell": i, "per_device_mb": round(bytes_dev / 2**20, 1)}
+        )
+    sp_sum = sum(c["per_device_mb"] for c in ledger["spatial_cells"])
+    tail_sum = sum(c["per_device_mb"] for c in ledger["tail_cells"])
+
+    # --- build + compile the flagship program at real shapes -------------
+    sp = SpatialCtx(axis_h="sph", axis_w="spw", grid_h=t, grid_w=t)
+    mesh = build_mesh(
+        MeshSpec(data=1, stage=S, sph=t, spw=t), jax.devices()[:n_dev]
+    )
+    opt = Optimizer("sgd", lr=0.001)
+    t0 = time.time()
+    # gather junction: batch_split needs microbatch % tiles² == 0, which
+    # bs1 (the north-star config) cannot satisfy.
+    spp = SPPipeline.build(model, params, S, sp, microbatch=1,
+                           junction="gather")
+    step = make_sp_pipeline_train_step(
+        spp, opt, mesh, parts=args.parts, compute_dtype=jnp.bfloat16,
+        remat=True, donate=True,
+    )
+    state = init_sp_pipeline_state(spp, params, opt, mesh)
+    x = jnp.zeros((args.parts * 1, px, px, 3), jnp.bfloat16)
+    y = jnp.zeros((args.parts * 1,), jnp.int32)
+    lowered = step.lower(state, x, y)
+    print(f"[readiness] lowered in {time.time()-t0:.0f}s; compiling...",
+          file=sys.stderr)
+    t0 = time.time()
+    compiled = lowered.compile()
+    compile_s = time.time() - t0
+    print(f"[readiness] compiled in {compile_s:.0f}s", file=sys.stderr)
+
+    ma = compiled.memory_analysis()
+    mem = {
+        "temp_gb": round(ma.temp_size_in_bytes / 2**30, 2),
+        "arg_gb": round(ma.argument_size_in_bytes / 2**30, 2),
+        "out_gb": round(ma.output_size_in_bytes / 2**30, 2),
+        "alias_gb": round(ma.alias_size_in_bytes / 2**30, 2),
+        "note": "per-device (SPMD module) on CPU layouts — no TPU tile "
+                "padding; TPU adds up to 2x on non-128-multiple channels",
+    }
+    per_dev_gb = (
+        ma.temp_size_in_bytes
+        + ma.argument_size_in_bytes
+        + ma.output_size_in_bytes
+        - ma.alias_size_in_bytes
+    ) / 2**30
+    comm = hlo_collective_stats(compiled.as_text())
+
+    out = {
+        "metric": "readiness_8k_per_device_gb",
+        "value": round(per_dev_gb, 2),
+        "unit": "GB/device",
+        "config": {
+            "image_size": px, "grid": f"{t}x{t}", "stages": S,
+            "parts": args.parts, "devices": n_dev,
+            "model": f"amoebanetd({args.num_layers},{args.num_filters})",
+        },
+        "compile_seconds": round(compile_s, 1),
+        "memory_analysis": mem,
+        "fits_v5p_95gb": per_dev_gb < V5P_HBM_GB,
+        "fits_v5e_16gb": per_dev_gb < V5E_HBM_GB,
+        "headroom_v5p_gb": round(V5P_HBM_GB - per_dev_gb, 1),
+        "collectives_per_step": {
+            k: v for k, v in comm.items() if isinstance(v, dict) and v["count"]
+        },
+        "collective_total_gb": round(comm["total_bytes"] / 2**30, 3),
+        "activation_ledger": {
+            "spatial_cells_sum_per_device_mb": round(sp_sum, 1),
+            "tail_cells_sum_total_mb": round(tail_sum, 1),
+            "largest_spatial_cell_mb": max(
+                (c["per_device_mb"] for c in ledger["spatial_cells"]),
+                default=0,
+            ),
+        },
+    }
+    line = json.dumps(out)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

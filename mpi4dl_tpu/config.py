@@ -113,13 +113,20 @@ def is_tpu_backend() -> bool:
 
 
 def resolve_pallas_conv(setting: Optional[bool]) -> bool:
-    """Resolve the tri-state ``pallas_conv`` config: ``None`` = auto — the
-    kernel is a Mosaic (TPU) program, so auto enables it only on TPU
-    backends (measured 1.2-2.3x over XLA's VALID conv at D2 shapes,
-    PERF_NOTES.md); CPU/GPU keep XLA conv (interpret mode is for tests)."""
+    """Resolve the tri-state ``pallas_conv`` config: ``None`` = auto = OFF.
+
+    The kernel wins 1.1-2.3x at the OP level at D2 shapes, but the r4
+    STEP-level A/B (benchmark_d2_step.py: full relu-conv-bn fused runs,
+    forward+backward+update, real chip) measured 0.62-1.06x — XLA's
+    conv+BN+ReLU fusion and layout propagation across the whole program
+    beat the kernel's op-level margin at every representative shape except
+    a statistical tie (PERF_NOTES r4; exactly the failure mode the r3
+    single-device SAME-conv measurement warned about).  ``--pallas-conv``
+    remains the explicit opt-in; CPU keeps XLA conv (interpret mode is for
+    tests)."""
     if setting is not None:
         return setting
-    return is_tpu_backend()
+    return False
 
 
 def get_parser() -> argparse.ArgumentParser:

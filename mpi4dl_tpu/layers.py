@@ -55,6 +55,12 @@ def _hstripe_enabled() -> bool:
 
 
 _HSTRIPE_MIN_PIXELS = 1 << 20
+# Pools at or below this input size take the phase-view strided reduction
+# (fast path); larger ones keep strided slices (see _window_reduce).
+# 256 MB covers the 1024² headline (109 MB pools); a 512 MB setting that
+# would cover the 2048² rung's 436 MB pools was tried and the rung's
+# compile did not finish inside 25 min on the tunnel — kept conservative.
+_PHASE_POOL_MAX_BYTES = 256 * 1024 * 1024
 
 Params = Any
 Shape = Tuple[int, ...]
@@ -205,8 +211,11 @@ class Conv2d(Layer):
                 (0, 0) if halo_h.lo else (ph, ph),
                 (0, 0) if halo_w.lo else (pw, pw),
             )
-            # Sharded runs use the Pallas margin-consuming kernel (measured
-            # faster than the unfusable VALID conv on an exchanged margin).
+            # Sharded runs MAY use the Pallas margin-consuming kernel — but
+            # only on explicit opt-in (sp.use_pallas_conv, checked by the
+            # dispatch gate): the r4 step-level A/B measured XLA's fused
+            # VALID conv equal-or-faster at every D2-representative shape
+            # despite the kernel's op-level wins (PERF_NOTES r4).
             use_pallas = True
         else:
             padding = ((ph, ph), (pw, pw))
@@ -471,7 +480,13 @@ def _window_reduce(x, kh, kw, sh, sw, ph, pw, op: str):
     fill = jnp.asarray(-jnp.inf if op == "max" else 0, x.dtype)
     oh = (h + 2 * ph - kh) // sh + 1
     ow = (w + 2 * pw - kw) // sw + 1
-    if sh > 1 or sw > 1:
+    # The phase view materializes a ~input-sized buffer that lives through
+    # the pool's backward; at the memory FRONTIER (AmoebaNet ≥3328², where
+    # pools at 1664-res × 208ch exceed a GB) that buffer costs trainable
+    # resolution, so huge pools keep the strided-slice form (slower:
+    # gathers + scatter chains — the throughput rungs never see it).
+    phase_ok = (n * h * w * c * x.dtype.itemsize) <= _PHASE_POOL_MAX_BYTES
+    if (sh > 1 or sw > 1) and phase_ok:
         # Phase view: padded row b = q·s + φ ↦ y[..., q, φ, ...].  Tap i of
         # output q reads padded row q·s + i = (q + i//s)·s + (i % s): a
         # unit-stride slice at phase i % s, offset i//s.  Rows/cols are
@@ -504,7 +519,10 @@ def _window_reduce(x, kh, kw, sh, sw, ph, pw, op: str):
     acc = None
     for i in range(kh):
         for j in range(kw):
-            piece = x[:, i : i + oh, j : j + ow, :]
+            piece = x[
+                :, i : i + (oh - 1) * sh + 1 : sh,
+                j : j + (ow - 1) * sw + 1 : sw, :,
+            ]
             if acc is None:
                 acc = piece
             elif op == "max":

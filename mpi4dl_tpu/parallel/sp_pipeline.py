@@ -283,7 +283,9 @@ def _make_sp_step(
                 c = dataclasses.replace(sp_ctx, bn_sink=sink)
             else:
                 sink, c = None, sp_ctx
-            act, _ = apply_spatial_region(spp.model, ps, xx, c, levels)
+            act, _ = apply_spatial_region(
+                spp.model, ps, xx, c, levels, remat=remat
+            )
             if not with_stats_sp:
                 return act, jnp.zeros((0,), jnp.float32)
             leaves = jax.tree.leaves(ps)

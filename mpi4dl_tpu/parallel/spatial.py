@@ -217,6 +217,7 @@ def apply_spatial_region(
     x: Act,
     ctx: ApplyCtx,
     levels: Levels,
+    remat=False,
 ) -> Tuple[Act, SpatialCtx]:
     """Run the spatial region: cells [0, stop_i) per level with that level's
     SpatialCtx, respatial transitions between levels.  Returns the activation
@@ -242,7 +243,11 @@ def apply_spatial_region(
             c = dataclasses.replace(
                 ctx.with_spatial(None), bn_stat_axes=ctx.bn_stat_axes + tile_axes
             )
-        x = model.apply(params_list, x, c, start=start, stop=stop)
+        # remat: per-cell checkpoints INSIDE the region — without this a
+        # region-level checkpoint's backward holds every cell's internals
+        # at once (measured 148 GB/device at the 8192² flagship; the
+        # readiness artifact's discovery, PERF_NOTES r4).
+        x = model.apply(params_list, x, c, start=start, stop=stop, remat=remat)
         start, prev = stop, sp_l
     assert prev is not None
     return x, prev
@@ -257,6 +262,7 @@ def apply_spatial_model(
     junction: str = "gather",
     levels: Optional[Levels] = None,
     local_dp: Optional[int] = None,
+    remat=False,
 ) -> Act:
     """Run the spatial region (one or more levels), junction, then the tail
     replicated (junction='gather') or batch-split (junction='batch_split',
@@ -273,7 +279,9 @@ def apply_spatial_model(
             spatial_until = model.spatial_until or (len(model.cells) - 1)
         levels = [(spatial_until, sp)]
 
-    x, sp_last = apply_spatial_region(model, params_list, x, ctx, levels)
+    x, sp_last = apply_spatial_region(
+        model, params_list, x, ctx, levels, remat=remat
+    )
     x = apply_junction(x, sp_last, junction, local_dp)
     # BN running-stat deposits in the tail must pmean over the former tile
     # axes: under 'batch_split' the batch genuinely varies per tile device;
@@ -286,4 +294,6 @@ def apply_spatial_model(
     tail_ctx = dataclasses.replace(
         ctx.with_spatial(None), bn_stat_axes=ctx.bn_stat_axes + tile_axes
     )
-    return model.apply(params_list, x, tail_ctx, start=levels[-1][0])
+    return model.apply(
+        params_list, x, tail_ctx, start=levels[-1][0], remat=remat
+    )

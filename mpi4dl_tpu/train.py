@@ -336,8 +336,11 @@ def make_spatial_train_step(
     levels=None,
     local_dp: Optional[int] = None,
     donate: bool = False,
+    remat=False,
 ):
     """SP(+DP) training step: one shard_map over the whole step.
+    ``remat`` threads per-cell checkpointing through the spatial region and
+    tail (False/True/"sqrt" — see CellModel.apply).
 
     Inside, convs/pools halo-exchange over sph/spw; after `spatial_until`
     cells the activation is gathered (SP→LP junction; 'batch_split' = the
@@ -362,7 +365,7 @@ def make_spatial_train_step(
         c = dataclasses.replace(ctx, bn_sink={}) if bn_stats else ctx
         logits = apply_spatial_model(
             model, params_list, x, c, spatial_until=spatial_until,
-            junction=junction, levels=levels, local_dp=local_dp,
+            junction=junction, levels=levels, local_dp=local_dp, remat=remat,
         )
         if isinstance(logits, tuple):
             logits = logits[0]

@@ -127,8 +127,12 @@ def test_amoebanet_spatial_forward_equals_single_device(devices8):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
 
 
-def test_spatial_train_step_matches_single_device(devices8):
-    """Two SGD steps under SP == two steps single-device (bn_cross_tile)."""
+@pytest.mark.parametrize("remat", [False, True])
+def test_spatial_train_step_matches_single_device(devices8, remat):
+    """Two SGD steps under SP == two steps single-device (bn_cross_tile).
+    remat=True threads per-cell checkpoints through the spatial region +
+    tail (r4: without in-region remat the region checkpoint's backward
+    holds every cell's internals at once) — must be value-identical."""
     from mpi4dl_tpu.train import Optimizer, TrainState, make_spatial_train_step, make_train_step
 
     sp = spatial_ctx_for("square", 4)
@@ -138,7 +142,7 @@ def test_spatial_train_step_matches_single_device(devices8):
     opt = Optimizer("sgd", lr=0.01)
 
     step_ref = make_train_step(model, opt)
-    step_sp = make_spatial_train_step(model, opt, mesh, sp)
+    step_sp = make_spatial_train_step(model, opt, mesh, sp, remat=remat)
 
     s_ref = TrainState.create(params, opt)
     s_sp = TrainState.create(params, opt)
