@@ -75,6 +75,11 @@ class SpatialCtx:
     # lax.conv.  Off by default — adoption is gated on the hardware
     # measurement (PERF_NOTES.md); everything else falls back to XLA.
     use_pallas_conv: bool = False
+    # The axes of this ctx are a SINGLE-DEVICE fiction (the H-striped
+    # layer-run executor, ops/hstripe_conv.hstripe_layer_run): no mesh axis
+    # exists, so BN statistic deposits must stay local — no pmean over the
+    # tile axes (the caller averages per-stripe updates itself).
+    stat_local: bool = False
 
     @property
     def active(self) -> bool:

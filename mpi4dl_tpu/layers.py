@@ -374,7 +374,8 @@ class BatchNorm(Layer):
         unbiased one (torch nn.BatchNorm2d semantics)."""
         sp = ctx.spatial
         names = list(ctx.bn_stat_axes)
-        if sp is not None and sp.active and not sp.bn_cross_tile:
+        if (sp is not None and sp.active and not sp.bn_cross_tile
+                and not sp.stat_local):
             names += [a for a in (sp.axis_h, sp.axis_w) if a]
         if ctx.data_axis:
             names.append(ctx.data_axis)

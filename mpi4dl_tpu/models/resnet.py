@@ -164,15 +164,30 @@ class ResBlockV2(Cell):
         return params, s
 
     def apply(self, params, x, ctx: ApplyCtx):
+        from mpi4dl_tpu.layers import _hstripe_enabled
         from mpi4dl_tpu.ops.d2 import maybe_run_d2
 
-        # D2: one halo exchange for the whole bottleneck (3x3 + 3x3 + 1x1).
-        y = maybe_run_d2(
-            list(self.r1.layers) + list(self.r2.layers) + list(self.r3.layers),
-            list(params["r1"]) + list(params["r2"]) + list(params["r3"]),
-            x,
-            ctx,
+        branch_layers = (
+            list(self.r1.layers) + list(self.r2.layers) + list(self.r3.layers)
         )
+        branch_params = (
+            list(params["r1"]) + list(params["r2"]) + list(params["r3"])
+        )
+        # D2: one halo exchange for the whole bottleneck (3x3 + 3x3 + 1x1).
+        y = maybe_run_d2(branch_layers, branch_params, x, ctx)
+        if y is None and self.stride == 1 and _hstripe_enabled():
+            # Single-device huge-spatial blocks run the branch H-stripe by
+            # H-stripe (ops/hstripe_conv.hstripe_layer_run) so the branch's
+            # full-size intermediates never materialize — the capacity
+            # lever for 2048²-class ResNet on one chip (PERF_NOTES r4).
+            # Semantics: halo-D2 pad-once borders + per-stripe train-BN
+            # statistics — both the reference's own high-res semantics.
+            from mpi4dl_tpu.ops.hstripe_conv import (
+                hstripe_layer_run, hstripe_run_eligible,
+            )
+
+            if hstripe_run_eligible(branch_layers, x.shape, ctx):
+                y = hstripe_layer_run(branch_layers, branch_params, x, ctx)
         if y is None:
             y = self.r1.apply(params["r1"], x, ctx)
             y = self.r2.apply(params["r2"], y, ctx)

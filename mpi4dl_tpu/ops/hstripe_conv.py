@@ -31,16 +31,20 @@ _DIMNUMS = ("NHWC", "HWIO", "NHWC")
 _PATCH_BUDGET = 192 * 1024 * 1024
 
 
+def _smallest_divisor_at_least(n: int, want: int) -> int:
+    """Smallest divisor of ``n`` that is >= ``want`` (n itself worst-case)."""
+    for s in range(max(1, want), n + 1):
+        if n % s == 0:
+            return s
+    return n
+
+
 def _pick_stripes(h: int, wid: int, cin: int, kh: int, kw: int,
                   itemsize: int) -> int:
     patch = h * wid * cin * kh * kw * itemsize
     if patch <= _PATCH_BUDGET:
         return 1
-    want = -(-patch // _PATCH_BUDGET)
-    for s in range(want, h + 1):
-        if h % s == 0:
-            return s
-    return h
+    return _smallest_divisor_at_least(h, -(-patch // _PATCH_BUDGET))
 
 
 def hstripe_conv2d(x: jax.Array, w: jax.Array,
@@ -102,3 +106,121 @@ def hstripe_conv2d(x: jax.Array, w: jax.Array,
 
     ys = lax.map(piece, jnp.arange(stripes))        # [S, N, sh, OW·Cout]
     return ys.transpose(1, 0, 2, 3).reshape(n, oh, ow, cout)
+
+
+# ---------------------------------------------------------------------------
+# H-striped LAYER-RUN execution — the block-level form.
+#
+# Striping convs one by one (above) bounds conv temps, but a residual
+# block's full-size INTERMEDIATE activations (BN/relu outputs between the
+# convs) still materialize at every layer boundary — in XLA's padded
+# narrow-channel layouts they were the last ~250 MB that kept ResNet-110
+# 2048² bs1 off the chip (PERF_NOTES r4).  Running the whole branch stripe
+# by stripe makes every intermediate a per-stripe transient.
+#
+# Semantics (both deviations are the REFERENCE'S OWN at high resolution,
+# documented in ops/d2.py and layers.BatchNorm):
+# - pad-once borders: the run's accumulated H margin is zero-padded once,
+#   convs run VALID on H (exactly halo-D2's border semantics;
+#   reference resnet_spatial_d2.py) — W keeps per-conv SAME padding;
+# - train-mode BatchNorm uses PER-STRIPE batch statistics (the reference's
+#   spatial ResNet uses per-TILE nn.BatchNorm2d the same way); margin rows
+#   are excluded from the statistics via the pre_margin machinery.  Eval
+#   mode uses running stats and has no statistics deviation.
+# ---------------------------------------------------------------------------
+
+# Per-stripe activation budget for the layer-run form (bytes of the
+# stripe's widest intermediate), and the input-size gate below which the
+# run is not worth striping.
+_RUN_STRIPE_BUDGET = 64 * 1024 * 1024
+_RUN_MIN_PIXELS = 1 << 20
+
+
+def hstripe_run_eligible(layers, x_shape, ctx) -> bool:
+    """Gate for the striped layer-run: single-device (no real spatial
+    sharding), stride-1 run with a positive accumulated H halo, tiny-C
+    huge-spatial input, all layers premargin-capable."""
+    from mpi4dl_tpu.ops.d2 import accumulated_halo, layer_d2_geometry
+
+    if ctx.spatial is not None:
+        return False
+    n, h, w, c = x_shape
+    if c > 64 or h * w < _RUN_MIN_PIXELS:
+        return False
+    acc = accumulated_halo(layers)
+    if acc is None or acc[0] <= 0:
+        return False
+    for layer in layers:
+        g = layer_d2_geometry(layer)
+        if g is None or g[2] != 1 or g[3] != 1:
+            return False
+    return True
+
+
+def hstripe_layer_run(layers, params_seq, x, ctx):
+    """Run a stride-1 layer sequence stripe-by-stripe over H.
+
+    x: [N, H, W, C] (unpadded).  The run's accumulated H margin is padded
+    once with zeros; each stripe carries the margin and the layers consume
+    it via :func:`mpi4dl_tpu.ops.d2.apply_layers_premargin` under a fake
+    H-sharded SpatialCtx (no collectives: bn_cross_tile off, exchanges
+    pre-consumed).  BN running-stat updates are averaged over stripes and
+    re-deposited into the caller's sink (the microbatch momentum-rule
+    equivalence, train.make_train_step docstring)."""
+    import dataclasses
+
+    from mpi4dl_tpu.layer_ctx import SpatialCtx
+    from mpi4dl_tpu.ops.d2 import accumulated_halo, apply_layers_premargin
+
+    n, h, w, c = x.shape
+    m = accumulated_halo(layers)[0]
+    # Stripe count sized to the run's WIDEST intermediate, not its input.
+    cmax = c
+    for layer in layers:
+        cmax = max(
+            cmax,
+            getattr(layer, "out_channels", 0),
+            getattr(layer, "num_features", 0),
+        )
+    per_row = w * cmax * x.dtype.itemsize * n
+    stripes = _smallest_divisor_at_least(
+        h, max(1, -(-(h * per_row) // _RUN_STRIPE_BUDGET))
+    )
+    sh = h // stripes
+    if stripes == 1 or sh < m + 1:
+        return None  # caller takes its normal path
+
+    sp_fake = SpatialCtx(
+        axis_h="sph", grid_h=stripes, bn_cross_tile=False, stat_local=True
+    )
+    sctx = ctx.with_spatial(sp_fake)
+    leaves = jax.tree.leaves(params_seq)
+
+    xp = jnp.pad(x, ((0, 0), (m, m), (0, 0), (0, 0)))
+    xf = xp.reshape(n, h + 2 * m, w * c)
+
+    def piece(i):
+        xs = lax.dynamic_slice_in_dim(xf, i * sh, sh + 2 * m, axis=1)
+        xs = xs.reshape(n, sh + 2 * m, w, c)
+        if ctx.bn_sink is not None:
+            inner: dict = {}
+            cc = dataclasses.replace(sctx, bn_sink=inner)
+        else:
+            inner, cc = None, sctx
+        y, mh, mw = apply_layers_premargin(layers, params_seq, xs, cc, m, 0)
+        assert mh == 0 and mw == 0, (mh, mw)
+        # The reassembly below assumes every layer preserves W (SAME pads on
+        # the unsharded dim) — a W-shrinking run would scramble the reshape.
+        assert y.shape[2] == w, (y.shape, w)
+        stats = (
+            [inner.get(id(l)) for l in leaves] if inner is not None else []
+        )
+        return y.reshape(n, sh, y.shape[2] * y.shape[3]), stats
+
+    ys, stats = lax.map(piece, jnp.arange(stripes))
+    oc = ys.shape[3] // w
+    if ctx.bn_sink is not None:
+        for leaf, s in zip(leaves, stats):
+            if s is not None:
+                ctx.bn_sink[id(leaf)] = jnp.mean(s, axis=0)
+    return ys.transpose(1, 0, 2, 3).reshape(n, h, w, oc)

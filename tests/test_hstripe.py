@@ -112,6 +112,121 @@ def test_boundary_packing_exact(monkeypatch, remat):
         )
 
 
+def _fake_sp_ctx(train=True):
+    from mpi4dl_tpu.layer_ctx import ApplyCtx, SpatialCtx
+
+    sp = SpatialCtx(axis_h="sph", grid_h=4, bn_cross_tile=False)
+    return ApplyCtx(train=train, spatial=sp)
+
+
+def test_hstripe_layer_run_matches_pad_once(monkeypatch):
+    """Striped layer-run == the pad-once margin-consuming emulation (the
+    halo-D2 semantics test_d2 pins distributed) — values and grads, on a
+    BN-free run where both are deterministic."""
+    from mpi4dl_tpu.layer_ctx import ApplyCtx
+    from mpi4dl_tpu.layers import Conv2d, ReLU
+    from mpi4dl_tpu.ops.d2 import accumulated_halo, apply_layers_premargin
+
+    monkeypatch.setattr(hc, "_RUN_STRIPE_BUDGET", 4000)
+    layers = [ReLU(), Conv2d(4, 8, 3, bias=False), ReLU(),
+              Conv2d(8, 8, 3, bias=False)]
+    params = []
+    shape = (2, 16, 12, 4)
+    for i, l in enumerate(layers):
+        pp, shape = l.init(jax.random.fold_in(jax.random.key(0), i), shape)
+        params.append(pp)
+    x = jax.random.normal(jax.random.key(1), (2, 16, 12, 4))
+    ctx = ApplyCtx(train=True)
+    m = accumulated_halo(layers)[0]
+
+    def striped(x):
+        y = hc.hstripe_layer_run(layers, params, x, ctx)
+        assert y is not None
+        return y
+
+    def emulated(x):
+        xp = jnp.pad(x, ((0, 0), (m, m), (0, 0), (0, 0)))
+        y, mh, mw = apply_layers_premargin(
+            layers, params, xp, _fake_sp_ctx(), m, 0
+        )
+        assert mh == 0 and mw == 0
+        return y
+
+    y_s, y_e = striped(x), emulated(x)
+    np.testing.assert_allclose(np.asarray(y_s), np.asarray(y_e), atol=1e-5)
+    g_s = jax.grad(lambda x: jnp.sum(striped(x) ** 2))(x)
+    g_e = jax.grad(lambda x: jnp.sum(emulated(x) ** 2))(x)
+    np.testing.assert_allclose(np.asarray(g_s), np.asarray(g_e), atol=1e-4)
+
+
+def test_resblock_v2_striped_eval_matches_pad_once(monkeypatch):
+    """The ResBlockV2 dispatch: striped branch in EVAL mode (BN running
+    stats — no statistics deviation) == pad-once emulation of the branch,
+    plus the skip add."""
+    from mpi4dl_tpu.layer_ctx import ApplyCtx
+    from mpi4dl_tpu.models.resnet import ResBlockV2
+    from mpi4dl_tpu.ops.d2 import accumulated_halo, apply_layers_premargin
+
+    monkeypatch.setattr(hc, "_RUN_MIN_PIXELS", 1)
+    monkeypatch.setattr(hc, "_RUN_STRIPE_BUDGET", 8000)
+    blk = ResBlockV2(8, 4, 8, 1, first_block=False, pre_activation=True)
+    params, _ = blk.init(jax.random.key(2), (1, 16, 16, 8))
+    x = jax.random.normal(jax.random.key(3), (1, 16, 16, 8))
+    ctx = ApplyCtx(train=False)
+    y = blk.apply(params, x, ctx)
+
+    layers = list(blk.r1.layers) + list(blk.r2.layers) + list(blk.r3.layers)
+    ps = list(params["r1"]) + list(params["r2"]) + list(params["r3"])
+    m = accumulated_halo(layers)[0]
+    xp = jnp.pad(x, ((0, 0), (m, m), (0, 0), (0, 0)))
+    want, mh, mw = apply_layers_premargin(
+        layers, ps, xp, _fake_sp_ctx(train=False), m, 0
+    )
+    np.testing.assert_allclose(
+        np.asarray(y), np.asarray(x + want), atol=1e-5
+    )
+
+
+def test_resblock_v2_striped_trains(monkeypatch):
+    """Train mode with per-stripe BN statistics: finite decreasing loss and
+    BN running stats actually updated through the stripe-averaged sink."""
+    from mpi4dl_tpu.cells import CellModel, LayerCell
+    from mpi4dl_tpu.layers import Dense, Flatten
+    from mpi4dl_tpu.models.resnet import ResBlockV2
+    from mpi4dl_tpu.train import Optimizer, TrainState, make_train_step
+
+    monkeypatch.setattr(hc, "_RUN_MIN_PIXELS", 1)
+    monkeypatch.setattr(hc, "_RUN_STRIPE_BUDGET", 8000)
+    cells = [
+        ResBlockV2(3, 4, 8, 1, first_block=True, pre_activation=False),
+        LayerCell([Flatten(), Dense(8 * 16 * 16, 10)], name="head"),
+    ]
+    model = CellModel(cells, (2, 16, 16, 3), 10)
+    params, _ = model.init(jax.random.key(0))
+    mean0 = np.array(
+        [np.asarray(p["mean"]) for p in jax.tree.leaves(
+            params, is_leaf=lambda q: isinstance(q, dict) and "mean" in q
+        ) if isinstance(p, dict) and "mean" in p][0]
+    )
+    opt = Optimizer("sgd", lr=0.05)
+    step = make_train_step(model, opt)
+    state = TrainState.create(params, opt)
+    x = jax.random.normal(jax.random.key(1), (2, 16, 16, 3))
+    y = jnp.arange(2, dtype=jnp.int32)
+    losses = []
+    for _ in range(3):
+        state, metr = step(state, x, y)
+        assert np.isfinite(float(metr["loss"]))
+        losses.append(float(metr["loss"]))
+    assert losses[-1] < losses[0], losses
+    mean1 = np.array(
+        [np.asarray(p["mean"]) for p in jax.tree.leaves(
+            state.params, is_leaf=lambda q: isinstance(q, dict) and "mean" in q
+        ) if isinstance(p, dict) and "mean" in p][0]
+    )
+    assert not np.allclose(mean0, mean1), "BN running mean never updated"
+
+
 def test_pack_meta_gates():
     from mpi4dl_tpu import cells as C
 
