@@ -440,6 +440,25 @@ def _max_trainable_px(start: int = 2048, cap: int = 8192,
     return best, attempts
 
 
+def _tpu_preflight(timeout_s: int = 240) -> bool:
+    """Can a subprocess reach the TPU at all?  When the axon tunnel is down
+    the backend init HANGS (measured >25 min) rather than failing — without
+    this check each TPU rung burns its full timeout and the ladder can
+    exhaust the deadline before ever reaching the CPU smoke rung."""
+    argv = [sys.executable, "-c",
+            "import jax; print(jax.devices()[0].platform)"]
+    try:
+        proc = subprocess.run(
+            argv, capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    # Last stdout line only: init banners/warnings must not mask a healthy
+    # tunnel (a false negative caps every TPU rung below its compile time).
+    lines = (proc.stdout or "").strip().splitlines()
+    return proc.returncode == 0 and bool(lines) and lines[-1] in ("tpu", "axon")
+
+
 def main() -> int:
     if len(sys.argv) > 1 and sys.argv[1] == "--inner":
         platform, image_size, num_layers, num_filters, warmup, iters, comp = sys.argv[2:9]
@@ -456,14 +475,29 @@ def main() -> int:
 
     failures = []
     headline = None
+    # Skip the preflight entirely when the deadline is nearly spent — the
+    # guaranteed JSON line outranks rung quality (an un-run preflight counts
+    # as failed, so surviving TPU rungs get the cheap-shot cap).
+    tpu_ok = _time_left() > 240 and _tpu_preflight(
+        min(240, max(60, int(_time_left() / 4)))
+    )
+    if not tpu_ok:
+        failures.append("tpu preflight failed (tunnel down or hung)")
+        print("[bench] TPU preflight FAILED — capping TPU rung timeouts",
+              file=sys.stderr)
     for rung in LADDER:
         # Clamp every rung to the remaining global budget (two 1800 s rungs
-        # would otherwise overrun DEADLINE_S when the tunnel hangs).
+        # would otherwise overrun DEADLINE_S when the tunnel hangs).  With a
+        # failed preflight each TPU rung gets one cheap shot only, so the
+        # CPU smoke rung is always reached within the deadline.
         left = _time_left()
         if left < 120:
             failures.append(f"{rung[0]}: skipped (bench deadline reached)")
             continue
-        rung = (*rung[:7], min(rung[7], max(60, int(left - 60))), *rung[8:])
+        cap = min(rung[7], max(60, int(left - 60)))
+        if rung[1] == "tpu" and not tpu_ok:
+            cap = min(cap, 120)
+        rung = (*rung[:7], cap, *rung[8:])
         print(f"[bench] trying rung {rung[0]}", file=sys.stderr)
         result, err = _try_rung(*rung)
         if result is not None:
