@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import json
 import os
+import re as _re
 import subprocess
 import sys
 import time
@@ -81,9 +82,61 @@ PROBE_TIMEOUT_S = 1200
 DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S", "3600"))
 _T0 = time.monotonic()
 
+# Incremental hardware-evidence file (VERDICT r4 task 1): every successful
+# rung is merged into this JSON the moment it lands, so a tunnel outage at
+# the END of a round can never zero the round's hardware record again.
+# bench.py also folds its contents into the final headline JSON.  The
+# default carries the CURRENT round's number (bump alongside VERDICT.md;
+# mid-round sessions can override via BENCH_MEASURED_PATH).  The .lock and
+# .tmp sidecars it creates are gitignored.
+MEASURED_PATH = os.environ.get(
+    "BENCH_MEASURED_PATH",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "MEASURED_r5.json"),
+)
+
 
 def _time_left() -> float:
     return DEADLINE_S - (time.monotonic() - _T0)
+
+
+def _record_measured(name: str, entry: dict) -> None:
+    """Atomically merge one successful rung into MEASURED_PATH.
+
+    Never raises: evidence recording must not break the benchmark.  Each
+    entry keeps its full rung_config so round-over-round numbers are
+    comparable without PERF_NOTES archaeology (VERDICT r4 weak-9).
+    """
+    try:
+        import fcntl
+
+        # flock around the read-modify-write: mid-round sessions and the
+        # bench ladder share this file, and last-writer-wins would silently
+        # drop rungs.
+        with open(MEASURED_PATH + ".lock", "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            data = {}
+            if os.path.exists(MEASURED_PATH):
+                with open(MEASURED_PATH) as f:
+                    data = json.load(f)
+            rungs = data.setdefault("rungs", {})
+            entry = dict(entry)
+            entry["captured_unix"] = int(time.time())
+            rungs[name] = entry
+            data["updated_unix"] = int(time.time())
+            tmp = MEASURED_PATH + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(data, f, indent=1, sort_keys=True)
+            os.replace(tmp, MEASURED_PATH)
+    except Exception as e:  # noqa: BLE001
+        print(f"[bench] measured-record failed: {e}", file=sys.stderr)
+
+
+def _load_measured() -> dict | None:
+    try:
+        with open(MEASURED_PATH) as f:
+            return json.load(f)
+    except Exception:  # noqa: BLE001
+        return None
 
 
 def _peak_flops(device) -> float | None:
@@ -380,6 +433,25 @@ def _try_rung(name, platform, image_size, num_layers, num_filters,
         err = f"{name}: {err}"
     if result is not None:
         result["remat"] = remat
+        # Frozen rung configuration (VERDICT r4 weak-9): everything needed to
+        # reproduce this number travels with it.
+        result["rung_config"] = {
+            "arch": arch, "image_size": image_size, "num_layers": num_layers,
+            "num_filters": num_filters, "batch": batch, "scan_steps": scan,
+            "remat": remat, "iters": iters, "input_dtype": "bfloat16",
+            "compute_dtype": "bfloat16", "optimizer": "sgd", "donate": True,
+        }
+        if result.get("platform") not in (None, "cpu"):
+            _record_measured(name, {
+                "img_per_sec": result.get("value"),
+                "mfu": result.get("mfu"),
+                "achieved_tflops": result.get("achieved_tflops"),
+                "timing_mode": result.get("timing_mode"),
+                "platform": result.get("platform"),
+                "device_kind": result.get("device_kind"),
+                "rung_config": result["rung_config"],
+                "error": result.get("error"),
+            })
     return result, err
 
 
@@ -392,6 +464,7 @@ def _rung_summary(result, err, baseline, baseline_key):
         "mfu": result.get("mfu"),
         "timing_mode": result.get("timing_mode"),
         "remat": result.get("remat"),
+        "rung_config": result.get("rung_config"),
         baseline_key: (
             round(result["value"] / baseline, 4)
             if (baseline and not result.get("error")) else None
@@ -401,24 +474,39 @@ def _rung_summary(result, err, baseline, baseline_key):
 
 
 def _max_trainable_px(start: int = 2048, cap: int = 8192,
-                      known_fit: int = 0) -> tuple[int, dict]:
+                      known_fit: int = 0, gate=None,
+                      note_ok=None) -> tuple[int, dict]:
     """Largest square resolution whose bs1 step completes on the chip.
 
     Doubling ladder from `start`, then one midpoint refinement between the
     last success and first failure.  Every attempt is a subprocess; any
     death (OOM, crash, timeout) counts as 'does not fit'.  ``known_fit``
     seeds the ladder with a resolution another rung already proved (avoids
-    re-paying its multi-minute compile+step).
+    re-paying its multi-minute compile+step).  ``gate`` (if given) is a
+    health predicate checked before each probe: a dead tunnel costs one
+    short probe, not a PROBE_TIMEOUT_S hang per resolution.
     """
     attempts = {}
 
     def fits(px: int) -> bool:
-        budget = min(PROBE_TIMEOUT_S, max(0, _time_left()))
+        if gate is not None and not gate():
+            attempts[str(px)] = {"ok": False,
+                                 "error": "skipped (tpu probe negative)"}
+            return False
+        # Budget computed AFTER the gate: its preflight may have spent
+        # minutes, and a stale budget would let a hung probe overrun
+        # DEADLINE_S.
+        budget = min(PROBE_TIMEOUT_S, max(0, _time_left() - 60))
         if budget < 120:
             attempts[str(px)] = {"ok": False, "error": "bench deadline reached"}
             return False
         result, err = _run_sub(["--probe", str(px)], budget)
         ok = bool(result and result.get("ok"))
+        if note_ok is not None and (ok or _re.search(_OOM_RE, err or "")):
+            # A parsed result OR an OOM death both prove live TPU contact —
+            # refresh the health cache so the next gate() call doesn't burn
+            # a redundant preflight subprocess (probes outlast FRESH_S).
+            note_ok()
         attempts[str(px)] = (
             {"ok": True, "first_step_s": result.get("first_step_s")} if ok
             else {"ok": False, "error": (err or "no output")[-300:]}
@@ -459,6 +547,67 @@ def _tpu_preflight(timeout_s: int = 240) -> bool:
     return proc.returncode == 0 and bool(lines) and lines[-1] in ("tpu", "axon")
 
 
+_OOM_RE = r"Ran out of memory|RESOURCE_EXHAUSTED|Out of memory"
+
+
+def _note_health(health, result, err) -> None:
+    """Update the health cache from a rung outcome.  An OOM death proves
+    live TPU contact just as a parsed result does — memory-frontier rungs
+    (tpu_2048, resnet_2048) OOM by DESIGN, and invalidating on them would
+    burn a redundant preflight before every subsequent gate."""
+    if result is not None or _re.search(_OOM_RE, err or ""):
+        health.note_success()
+    else:
+        health.note_rung_failure()
+
+
+class _TpuHealth:
+    """Per-rung TPU reachability tracking (VERDICT r4 weak-1 fix).
+
+    The r4 design probed ONCE up front and a negative stuck for the whole
+    run — a tunnel that recovered mid-bench still yielded a CPU-only round.
+    This tracker re-probes before each TPU rung group: a recent success
+    (a passed probe OR a rung that actually produced a TPU number) is
+    trusted for ``FRESH_S``; after a failure the next TPU rung triggers a
+    fresh probe instead of inheriting the stale verdict.
+    """
+
+    FRESH_S = 300.0
+
+    def __init__(self):
+        self._last_ok = None  # monotonic timestamp of last proven contact
+        self.consec_fail = 0
+
+    def note_success(self) -> None:
+        self._last_ok = time.monotonic()
+        self.consec_fail = 0
+
+    def note_rung_failure(self) -> None:
+        # A timed-out/failed TPU rung invalidates the cached health — the
+        # next rung must re-probe rather than burn its full budget.
+        self._last_ok = None
+
+    def check(self) -> bool:
+        if self._last_ok is not None and (
+            time.monotonic() - self._last_ok < self.FRESH_S
+        ):
+            return True
+        if _time_left() <= 90:
+            return False
+        budget = min(240, max(60, int(_time_left() / 4)))
+        ok = _tpu_preflight(budget)
+        if not ok and self.consec_fail == 0 and _time_left() > 240:
+            # One immediate retry on the FIRST failure only: a transient
+            # blip must not forfeit a TPU rung, but a dead tunnel must not
+            # eat two probes before every rung.
+            ok = _tpu_preflight(budget)
+        if ok:
+            self.note_success()
+        else:
+            self.consec_fail += 1
+        return ok
+
+
 def main() -> int:
     if len(sys.argv) > 1 and sys.argv[1] == "--inner":
         platform, image_size, num_layers, num_filters, warmup, iters, comp = sys.argv[2:9]
@@ -475,45 +624,70 @@ def main() -> int:
 
     failures = []
     headline = None
-    # Skip the preflight entirely when the deadline is nearly spent — the
-    # guaranteed JSON line outranks rung quality (an un-run preflight counts
-    # as failed, so surviving TPU rungs get the cheap-shot cap).  A single
-    # failure gets ONE retry: a transient blip must not forfeit the whole
-    # TPU benchmark (capped rungs sit below their compile times).
-    if _time_left() <= 240:
-        tpu_ok = False
-        failures.append("tpu preflight skipped (deadline nearly spent)")
-    else:
-        budget = lambda: min(240, max(60, int(_time_left() / 4)))
-        tpu_ok = _tpu_preflight(budget())
-        if not tpu_ok and _time_left() > 240:
-            tpu_ok = _tpu_preflight(budget())
-        if not tpu_ok:
-            failures.append("tpu preflight failed twice (tunnel down or hung)")
-    if not tpu_ok:
-        print("[bench] TPU preflight negative — capping TPU rung timeouts",
+    health = _TpuHealth()
+
+    def try_ladder():
+        nonlocal headline
+        for rung in LADDER:
+            # Clamp every rung to the remaining global budget (two 1800 s
+            # rungs would otherwise overrun DEADLINE_S when the tunnel
+            # hangs).  TPU rungs are gated on a fresh health probe — a rung
+            # that runs gets a FULL compile-sized budget (≥300 s when the
+            # deadline allows; the r4 design's 120 s cheap-shot cap sat
+            # below the 155 s compile and could never succeed).
+            left = _time_left()
+            if left < 120:
+                failures.append(f"{rung[0]}: skipped (bench deadline reached)")
+                continue
+            if rung[1] == "tpu":
+                # A TPU rung needs a full compile-sized budget (≥300 s) AND
+                # must stay inside the deadline — if the remaining time
+                # can't grant both, skip to the CPU smoke rung rather than
+                # either fire a doomed short rung (the r4 cheap-shot
+                # failure) or overrun DEADLINE_S.
+                if left < 390:
+                    failures.append(
+                        f"{rung[0]}: skipped (deadline leaves <300s budget)")
+                    continue
+                if not health.check():
+                    failures.append(f"{rung[0]}: skipped (tpu probe negative)")
+                    print(f"[bench] skipping {rung[0]} — probe negative",
+                          file=sys.stderr)
+                    continue
+                # Re-check after the probe spent its share of the budget.
+                if _time_left() - 60 < 300:
+                    failures.append(
+                        f"{rung[0]}: skipped (deadline leaves <300s budget)")
+                    continue
+                cap = min(rung[7], int(_time_left() - 60))
+            else:
+                cap = min(rung[7], max(60, int(left - 60)))
+            rung = (*rung[:7], cap, *rung[8:])
+            print(f"[bench] trying rung {rung[0]}", file=sys.stderr)
+            result, err = _try_rung(*rung)
+            if result is not None:
+                headline = result
+                headline["rung"] = rung[0]
+                if result.get("platform") != "cpu":
+                    health.note_success()
+                return
+            if rung[1] == "tpu":
+                health.note_rung_failure()
+            failures.append(err)
+            print(f"[bench] rung failed: {err}", file=sys.stderr)
+
+    try_ladder()
+    if (headline is not None and headline.get("platform") == "cpu"
+            and _time_left() > 900 and health.check()):
+        # The tunnel recovered after the TPU rungs failed (the r4 fatal
+        # pattern, inverted): spend the remaining budget on a real retry.
+        print("[bench] tunnel recovered — retrying TPU headline",
               file=sys.stderr)
-    for rung in LADDER:
-        # Clamp every rung to the remaining global budget (two 1800 s rungs
-        # would otherwise overrun DEADLINE_S when the tunnel hangs).  With a
-        # failed preflight each TPU rung gets one cheap shot only, so the
-        # CPU smoke rung is always reached within the deadline.
-        left = _time_left()
-        if left < 120:
-            failures.append(f"{rung[0]}: skipped (bench deadline reached)")
-            continue
-        cap = min(rung[7], max(60, int(left - 60)))
-        if rung[1] == "tpu" and not tpu_ok:
-            cap = min(cap, 120)
-        rung = (*rung[:7], cap, *rung[8:])
-        print(f"[bench] trying rung {rung[0]}", file=sys.stderr)
-        result, err = _try_rung(*rung)
-        if result is not None:
-            headline = result
-            headline["rung"] = rung[0]
-            break
-        failures.append(err)
-        print(f"[bench] rung failed: {err}", file=sys.stderr)
+        cpu_headline = headline
+        headline = None
+        try_ladder()
+        if headline is None or headline.get("platform") == "cpu":
+            headline = cpu_headline
 
     if headline is None:
         print(json.dumps({
@@ -529,29 +703,41 @@ def main() -> int:
     skip_extra = (
         os.environ.get("BENCH_SKIP_MEMORY_RUNGS") == "1" or _time_left() < 300
     )
+
+    def tpu_gate(rname: str) -> bool:
+        """Health-gated admission for each extra TPU rung: a mid-bench
+        tunnel death costs one short probe per rung, not a full timeout."""
+        if health.check():
+            return True
+        headline.setdefault("rungs", {})[rname] = {
+            "error": "skipped (tpu probe negative)"}
+        return False
+
     if on_tpu and not skip_extra:
         # Memory-capability rung: the reference's OOM frontier (2048², bs1 —
         # its GPUs OOM at bs2 across all schemes, BASELINE.md).
-        print("[bench] 2048px memory rung", file=sys.stderr)
-        # scan=1 on memory-frontier rungs: the scan-of-steps wrapper costs
-        # ~3.7 GB peak at 2048² (measured r4, unexplained — likely carry
-        # double-buffering), which a frontier rung cannot afford.
-        r2048, err = _try_rung(
-            "tpu_2048", "tpu", 2048, 18, 416, 1, 4,
-            min(1800, max(300, _time_left() - 300)), False, "cell", 1, 1,
-        )
-        headline["rungs"] = {
-            "2048": _rung_summary(r2048, err, BASELINE_2048,
-                                  "vs_baseline_cluster_2048"),
-        }
+        headline["rungs"] = {}
+        r2048, err = None, "skipped"
+        if tpu_gate("2048"):
+            print("[bench] 2048px memory rung", file=sys.stderr)
+            # scan=1 on memory-frontier rungs: the scan-of-steps wrapper
+            # costs ~3.7 GB peak at 2048² (measured r4 — likely carry
+            # double-buffering), which a frontier rung cannot afford.
+            r2048, err = _try_rung(
+                "tpu_2048", "tpu", 2048, 18, 416, 1, 4,
+                min(1800, max(300, _time_left() - 300)), False, "cell", 1, 1,
+            )
+            _note_health(health, r2048, err)
+            headline["rungs"]["2048"] = _rung_summary(
+                r2048, err, BASELINE_2048, "vs_baseline_cluster_2048")
         # Batch-scaling rungs at the flagship resolution (VERDICT r3 task 2:
         # the reference scales positively bs1→bs2; bs4/bs8 chart the curve).
         # no-remat first, remat fallback on OOM.
-        import re as _re
-
         for bname, bs, rung_scan in (
             ("1024_bs2", 2, 4), ("1024_bs4", 4, 2), ("1024_bs8", 8, 1),
         ):
+            if not tpu_gate(bname):
+                continue
             print(f"[bench] 1024px bs{bs} rung", file=sys.stderr)
             r_b, b_errs = None, []
             for rm in ("none", "cell"):
@@ -564,13 +750,13 @@ def main() -> int:
                     rung_scan,
                 )
                 if r_b is not None:
+                    health.note_success()
                     break
                 b_errs.append(f"{rm}: {e}")
-                if not _re.search(
-                    r"Ran out of memory|RESOURCE_EXHAUSTED|Out of memory", e or ""
-                ):
+                if not _re.search(_OOM_RE, e or ""):
                     # Only OOM justifies the remat retry; a hang/backend
                     # failure would just burn the probes' budget.
+                    health.note_rung_failure()
                     break
             headline["rungs"][bname] = _rung_summary(
                 r_b, "; ".join(b_errs),
@@ -588,12 +774,15 @@ def main() -> int:
             if _time_left() < 300:
                 headline["rungs"][rname] = {"error": "bench deadline reached"}
                 continue
+            if not tpu_gate(rname):
+                continue
             print(f"[bench] {rname} rung", file=sys.stderr)
             r_rn, e_rn = _try_rung(
                 f"tpu_{rname}", "tpu", rpx, 110, 0, 1, 2 * rscan,
                 min(1200, max(300, _time_left() - 300)), False, "sqrt", 1,
                 rscan, "resnet",
             )
+            _note_health(health, r_rn, e_rn)
             headline["rungs"][rname] = _rung_summary(
                 r_rn, e_rn, rbase, f"vs_baseline_cluster_{rname}"
             )
@@ -605,9 +794,20 @@ def main() -> int:
         best, attempts = _max_trainable_px(
             start=1024 if not rung_ok else 4096,
             known_fit=2048 if rung_ok else 0,
+            gate=health.check, note_ok=health.note_success,
         )
         headline["max_trainable_px"] = best
         headline["max_trainable_px_attempts"] = attempts
+
+    # Fold the incrementally-captured hardware evidence into the driver's
+    # record: even if THIS run landed on the CPU smoke rung, any hardware
+    # numbers measured earlier in the round (mid-round sessions write the
+    # same file) still reach BENCH_r*.json (VERDICT r4 fatal-gap fix).
+    measured = _load_measured()
+    if measured and measured.get("rungs"):
+        headline["midround_measured"] = measured["rungs"]
+    if failures:
+        headline["ladder_failures"] = [f for f in failures if f][-6:]
 
     print(json.dumps(headline))
     return 0

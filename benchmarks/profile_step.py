@@ -37,13 +37,18 @@ def capture(args) -> str:
     print(f"[profile] device={dev} kind={getattr(dev, 'device_kind', '?')}",
           file=sys.stderr)
 
+    if args.sqrt_groups:
+        # bench._build_step sets this for its ResNet rungs; the profiler
+        # must be able to reproduce the exact frontier configuration.
+        os.environ["MPI4DL_SQRT_GROUPS"] = str(args.sqrt_groups)
     step, state = _build_step(
         args.image_size, args.num_layers, args.num_filters, args.batch,
-        remat=_REMAT[args.remat],
+        remat=_REMAT[args.remat], arch=args.arch,
     )
     xs = [
         jax.random.normal(jax.random.key(100 + i),
-                          (args.batch, args.image_size, args.image_size, 3))
+                          (args.batch, args.image_size, args.image_size, 3),
+                          jnp.bfloat16)
         for i in range(2)
     ]
     ys = [jnp.full((args.batch,), i % 1000, jnp.int32) for i in range(2)]
@@ -127,7 +132,13 @@ def main() -> int:
     ap.add_argument("--batch", type=int, default=1)
     ap.add_argument("--num-layers", type=int, default=18)
     ap.add_argument("--num-filters", type=int, default=416)
-    ap.add_argument("--remat", default="none", choices=["none", "cell", "fine"])
+    ap.add_argument("--arch", default="amoeba", choices=["amoeba", "resnet"],
+                    help="resnet: --num-layers carries the depth (110)")
+    ap.add_argument("--remat", default="none",
+                    choices=["none", "cell", "fine", "sqrt"])
+    ap.add_argument("--sqrt-groups", type=int, default=0,
+                    help="MPI4DL_SQRT_GROUPS for --remat sqrt (bench.py's "
+                         "ResNet rungs use 16)")
     ap.add_argument("--steps", type=int, default=5)
     ap.add_argument("--out", default="/tmp/xprof_step")
     ap.add_argument("--analyze", default=None,

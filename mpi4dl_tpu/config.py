@@ -49,10 +49,11 @@ class ParallelConfig:
     momentum: float = 0.0
     optimizer: str = "sgd"
     remat: bool = True  # jax.checkpoint each stage application
-    # Route eligible SP convs through the Pallas kernel.  None = auto:
-    # enabled on TPU backends, off elsewhere (measured 1.2-2.3x over XLA's
-    # VALID conv at D2 shapes on v5e — PERF_NOTES.md); resolved at mesh
-    # build time by resolve_pallas_conv().
+    # Route eligible SP convs through the Pallas kernel.  None = auto = OFF:
+    # the op-level wins (1.2-2.3x at D2 shapes on v5e) did NOT survive the
+    # step-level A/B — XLA's conv+BN+ReLU fusion beats the kernel in whole
+    # programs (PERF_NOTES r4, benchmark_d2_step.py).  --pallas-conv is the
+    # explicit opt-in; resolved by resolve_pallas_conv().
     pallas_conv: Optional[bool] = None
     verbose: bool = False  # debug logging (reference parser.py --verbose)
     checkpoint_dir: Optional[str] = None

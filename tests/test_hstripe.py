@@ -50,6 +50,65 @@ def test_hstripe_conv2d_matches_lax(monkeypatch, kh, kw, h, w, cin, cout, ph, pw
     np.testing.assert_allclose(np.asarray(gw), np.asarray(gw_r), atol=1e-4)
 
 
+def test_hstripe_ragged_near_prime_height(monkeypatch):
+    """A near-prime output height (advisor r4: oh=2039-class) must NOT
+    degenerate into per-row scan steps: the stripe count stays the
+    budget-derived value via a ragged (zero-padded) final stripe, and the
+    result is still exact."""
+    monkeypatch.setattr(hc, "_PATCH_BUDGET", 6000)
+    k1, k2 = jax.random.split(jax.random.key(2))
+    # VALID 3x3 on h=61 -> oh=59 (prime)
+    x = jax.random.normal(k1, (1, 61, 8, 4))
+    wk = jax.random.normal(k2, (3, 3, 4, 4)) / 9
+    want = hc._pick_stripes(59, 8, 4, 3, 3, x.dtype.itemsize)
+    assert 1 < want < 30  # the budget asks for a handful, not per-row
+    y = hc.hstripe_conv2d(x, wk, (0, 0), (0, 0))
+    np.testing.assert_allclose(
+        np.asarray(y), np.asarray(_ref(x, wk, (0, 0), (0, 0))), atol=1e-5
+    )
+
+    t = jax.random.normal(k1, y.shape)
+    gx, gw = jax.grad(
+        lambda x, w_: jnp.sum(hc.hstripe_conv2d(x, w_, (0, 0), (0, 0)) * t),
+        (0, 1),
+    )(x, wk)
+    gx_r, gw_r = jax.grad(
+        lambda x, w_: jnp.sum(_ref(x, w_, (0, 0), (0, 0)) * t), (0, 1)
+    )(x, wk)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(gx_r), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(gw_r), atol=1e-4)
+
+
+def test_hstripe_run_near_prime_falls_back(monkeypatch):
+    """The LAYER-RUN form cannot take a ragged stripe (zero rows would
+    enter per-stripe BN statistics), so a height with no reasonable
+    divisor must return None — the caller's plain path."""
+    from mpi4dl_tpu.layer_ctx import ApplyCtx
+    from mpi4dl_tpu.layers import Conv2d
+
+    monkeypatch.setattr(hc, "_RUN_STRIPE_BUDGET", 2000)
+    conv = Conv2d(4, 4, kernel_size=3, padding=1)
+    params, _ = conv.init(jax.random.key(3), (1, 59, 8, 4))  # 59 prime
+    ctx = ApplyCtx(train=True, spatial=None)
+    out = hc.hstripe_layer_run([conv], [params],
+                               jnp.ones((1, 59, 8, 4)), ctx)
+    assert out is None
+
+
+def test_hstripe_run_mode_env(monkeypatch):
+    """MPI4DL_HSTRIPE_RUN=0 disables block striping outright."""
+    from mpi4dl_tpu.layer_ctx import ApplyCtx
+    from mpi4dl_tpu.layers import Conv2d
+
+    monkeypatch.setattr(hc, "_RUN_MIN_PIXELS", 1)
+    conv = Conv2d(4, 4, kernel_size=3, padding=1)
+    ctx = ApplyCtx(train=True, spatial=None)
+    monkeypatch.setenv("MPI4DL_HSTRIPE_RUN", "0")
+    assert not hc.hstripe_run_eligible([conv], (1, 64, 8, 4), ctx)
+    monkeypatch.setenv("MPI4DL_HSTRIPE_RUN", "1")
+    assert hc.hstripe_run_eligible([conv], (1, 64, 8, 4), ctx)
+
+
 def test_hstripe_single_stripe_is_plain_conv():
     """Under the budget the function must be exactly lax.conv (no scan)."""
     k1, k2 = jax.random.split(jax.random.key(1))
