@@ -1,0 +1,181 @@
+"""Attribute XLA layout-conversion copies in the headline step to model ops.
+
+The r4 hardware profile (PERF_NOTES.md) shows ~51 ms/step of pure
+layout-conversion copies (`T(8,128)` <-> narrow `T(2,128)` flips around convs
+at C in {208,416,624}) plus loop fusions running well under HBM speed —
+together the bulk of the 0.18-mfu gap.  XProf names the copy ops but not
+*which model op* forces each flip; this tool does: it compiles the exact
+bench.py headline step for the live backend, walks the optimized HLO, and for
+every explicit `copy`/`transpose`/`bitcast-convert` instruction — at module
+scope or inside fusion bodies (the line scan does not care about scope) —
+prints result bytes, the operand/result layouts, and the `op_name` metadata
+XLA preserves from the JAX trace (the model-source attribution).  Layout
+flips absorbed entirely into a fusion's output layout (no copy instruction
+anywhere) are NOT visible here; cross-check class totals against XProf
+(benchmarks/profile_step.py).
+
+Usage (TPU; compile-only, no timed steps):
+    python benchmarks/layout_probe.py --image-size 1024 --remat none --top 30
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+from collections import defaultdict
+
+_DTYPE_BYTES = {
+    "f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4, "pred": 1,
+    "s8": 1, "u8": 1, "f64": 8, "s64": 8, "u64": 8, "s16": 2, "u16": 2,
+}
+
+# e.g. bf16[1,256,256,208]{3,2,1,0:T(8,128)(2,1)}
+_SHAPE_RE = re.compile(
+    r"(?P<dt>\w+)\[(?P<dims>[\d,]*)\]\{(?P<minor>[\d,]+)"
+    r"(?::(?P<tiles>[^}]*))?\}"
+)
+_TILE_RE = re.compile(r"T\(([\d,]+)\)")
+
+
+def parse_shape(text: str):
+    m = _SHAPE_RE.search(text)
+    if not m:
+        return None
+    dims = [int(d) for d in m.group("dims").split(",") if d] or [1]
+    order = [int(d) for d in m.group("minor").split(",")]
+    tiles = _TILE_RE.findall(m.group("tiles") or "")
+    return m.group("dt"), dims, order, tiles
+
+
+def padded_bytes(dt: str, dims, order, tiles) -> int:
+    """Physical bytes including tile padding (first T(...) tile only)."""
+    esz = _DTYPE_BYTES.get(dt, 4)
+    logical = 1
+    for d in dims:
+        logical *= d
+    if not tiles:
+        return logical * esz
+    tile = [int(t) for t in tiles[0].split(",")]
+    # Layout order lists dims minor-to-major? No: HLO {3,2,1,0} lists
+    # minor_to_major, first entry = minor-most dim index.
+    phys = list(dims)
+    for i, tdim in enumerate(reversed(tile)):
+        if i < len(order):
+            di = order[i]
+            phys[di] = -(-dims[di] // tdim) * tdim
+    total = 1
+    for d in phys:
+        total *= d
+    return total * esz
+
+
+def layout_str(dt: str, dims, order, tiles) -> str:
+    t = "".join(f"T({x})" for x in tiles)
+    return f"{dt}[{','.join(map(str, dims))}]{{{','.join(map(str, order))}:{t}}}"
+
+
+def probe(args) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from bench import _REMAT, _build_step
+
+    dev = jax.devices()[0]
+    print(f"[layout_probe] device={dev}", file=sys.stderr)
+    step, state = _build_step(
+        args.image_size, args.num_layers, args.num_filters, args.batch,
+        remat=_REMAT[args.remat], scan=1, arch=args.arch,
+    )
+    x = jax.random.normal(
+        jax.random.key(0),
+        (args.batch, args.image_size, args.image_size, 3), jnp.bfloat16)
+    y = jnp.zeros((args.batch,), jnp.int32)
+    compiled = step.lower(state, x, y).compile()
+    hlo = compiled.as_text()
+    if args.dump:
+        with open(args.dump, "w") as f:
+            f.write(hlo)
+        print(f"[layout_probe] HLO -> {args.dump} ({len(hlo)} bytes)",
+              file=sys.stderr)
+    analyze_text(hlo, args.top)
+
+
+def analyze_text(hlo: str, top: int) -> None:
+    # Map instruction name -> its result-shape text (for operand lookup).
+    shape_of = {}
+    inst_re = re.compile(r"^\s*(?:ROOT\s+)?%?([\w.\-]+) = (.+)$")
+    lines = hlo.splitlines()
+    for ln in lines:
+        m = inst_re.match(ln)
+        if m:
+            shape_of[m.group(1)] = m.group(2)
+
+    convert_bytes = defaultdict(int)
+    convert_count = defaultdict(int)
+    op_names = defaultdict(set)
+    copy_re = re.compile(
+        r"^\s*(?:ROOT\s+)?%?([\w.\-]+) = (.*?)\s(copy|transpose|bitcast-convert)"
+        r"\(%?([\w.\-]+)", )
+    meta_re = re.compile(r'op_name="([^"]*)"')
+    total = 0
+    for ln in lines:
+        m = copy_re.match(ln)
+        if not m:
+            continue
+        name, res_text, kind, operand = m.groups()
+        res = parse_shape(res_text)
+        src_text = shape_of.get(operand, "")
+        src = parse_shape(src_text)
+        if res is None:
+            continue
+        rb = padded_bytes(*res)
+        key_src = layout_str(*src) if src else "?"
+        key = (kind, key_src, layout_str(*res))
+        convert_bytes[key] += rb  # result (dst) bytes, padded
+        convert_count[key] += 1
+        total += rb
+        mm = meta_re.search(ln)
+        if mm:
+            op_names[key].add(mm.group(1)[-110:])
+
+    print(f"\n== layout/format conversions (copy/transpose/bitcast), "
+          f"{sum(convert_count.values())} ops ==")
+    ranked = sorted(convert_bytes.items(), key=lambda kv: -kv[1])[:top]
+    for key, b in ranked:
+        kind, src, dst = key
+        print(f"\n{b / 1e6:9.1f} MB x{convert_count[key]:<4} {kind}")
+        print(f"    from {src}")
+        print(f"    to   {dst}")
+        for n in sorted(op_names[key])[:4]:
+            print(f"    op: …{n}")
+    print(f"\ntotal dst bytes across conversions: {total / 1e6:.1f} MB "
+          f"(src-side read traffic adds ~1x on top)")
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--image-size", type=int, default=1024)
+    p.add_argument("--num-layers", type=int, default=18)
+    p.add_argument("--num-filters", type=int, default=416)
+    p.add_argument("--batch", type=int, default=1)
+    p.add_argument("--remat", default="none",
+                   choices=["none", "cell", "fine", "sqrt"])
+    p.add_argument("--arch", default="amoeba", choices=["amoeba", "resnet"])
+    p.add_argument("--top", type=int, default=25)
+    p.add_argument("--dump", default="",
+                   help="also write the optimized HLO text here")
+    p.add_argument("--analyze", default="",
+                   help="skip compile; analyze an existing HLO text file")
+    args = p.parse_args()
+    if args.analyze:
+        with open(args.analyze) as f:
+            analyze_text(f.read(), args.top)
+        return
+    probe(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,75 @@
+"""Compile-only peak-HBM probe for a bench rung configuration.
+
+Asks XLA (via ``compiled.memory_analysis()``) what a training step's peak
+device memory is WITHOUT running it — the fast way to chart the memory
+frontier (ResNet-110-v2 2048², AmoebaNet 3328²+) against the ~15.75 GB
+usable HBM of a 16 GB chip, and to A/B memory levers (boundary packing,
+remat grouping) without burning a full rung timeout per point.
+
+    python benchmarks/mem_probe.py --arch resnet --image-size 2048 \
+        --num-layers 110 --remat sqrt --scan 1
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--image-size", type=int, default=2048)
+    p.add_argument("--num-layers", type=int, default=110)
+    p.add_argument("--num-filters", type=int, default=416)
+    p.add_argument("--batch", type=int, default=1)
+    p.add_argument("--remat", default="sqrt",
+                   choices=["none", "cell", "fine", "sqrt"])
+    p.add_argument("--arch", default="resnet", choices=["amoeba", "resnet"])
+    p.add_argument("--scan", type=int, default=1)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from bench import _REMAT, _build_step
+
+    dev = jax.devices()[0]
+    print(f"[mem_probe] device={dev}", file=sys.stderr)
+    step, state = _build_step(
+        args.image_size, args.num_layers, args.num_filters, args.batch,
+        remat=_REMAT[args.remat], scan=args.scan, arch=args.arch,
+    )
+    shp = (args.batch, args.image_size, args.image_size, 3)
+    if args.scan > 1:
+        shp = (args.scan,) + shp
+    x = jax.random.normal(jax.random.key(0), shp, jnp.bfloat16)
+    y = jnp.zeros(
+        (args.scan, args.batch) if args.scan > 1 else (args.batch,), jnp.int32
+    )
+    t0 = time.perf_counter()
+    compiled = step.lower(state, x, y).compile()
+    ma = compiled.memory_analysis()
+    out = {
+        "config": vars(args),
+        "compile_s": round(time.perf_counter() - t0, 1),
+    }
+    for k in ("temp_size_in_bytes", "argument_size_in_bytes",
+              "output_size_in_bytes", "alias_size_in_bytes",
+              "generated_code_size_in_bytes"):
+        v = getattr(ma, k, None)
+        if v is not None:
+            out[k] = int(v)
+    temp = out.get("temp_size_in_bytes", 0)
+    arg = out.get("argument_size_in_bytes", 0)
+    alias = out.get("alias_size_in_bytes", 0)
+    # Peak live ≈ args + temps (donated args counted once via alias).
+    out["peak_gb_est"] = round((temp + arg - alias) / 2**30, 3)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

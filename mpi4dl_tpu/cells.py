@@ -184,50 +184,49 @@ class CellModel:
 
 
 # ---------------------------------------------------------------------------
-# Boundary channel-packing: tiny-channel huge-spatial checkpoint residuals.
+# Boundary lane-packing: large checkpoint residuals stored exactly-128-lane.
 #
 # A [1, 2048, 2048, 64] bf16 boundary costs 1 GB on TPU — 2x its real size —
 # because any channels-minor layout pads C=64 to the 128-lane tile (and XLA's
 # backward temps for such shapes showed up in T(2,128) layouts padded 4-16x,
 # the measured ResNet-110 2048² OOM driver after conv temps were fixed,
-# PERF_NOTES r4).  Packing p = 128/C adjacent W pixels into channels makes
-# every saved residual (and its cotangent) an exactly-128-lane tensor with no
-# padding at all.  The pack/unpack reshapes live INSIDE the checkpoint, so
-# only the packed form is ever stored.  Shape-gated: huge-spatial only, C a
-# divisor of 128, W divisible by p — packs nothing otherwise (zero graph
-# change; AmoebaNet channels are all >= 128 and never pack).
+# PERF_NOTES r4).  Re-splitting the flattened (W, C) trailing dims as
+# (W*C/128, 128) makes every saved residual (and its cotangent) an
+# exactly-128-lane tensor with no padding at all — and a shape whose natural
+# layout XLA stores densely packed (the r4 AmoebaNet frontier's binding mass,
+# [1,416,416,1664] bf16, measured ~2x its 553 MB logical size: an unpacked
+# narrow-tile layout this reshape makes impossible).  The pack/unpack
+# reshapes live INSIDE the checkpoint, so only the packed form is ever
+# stored.  Gated to large boundaries with W*C a multiple of 128 (and C not
+# already exactly 128); packs nothing otherwise — zero graph change.
 # ---------------------------------------------------------------------------
 
-_PACK_MIN_PIXELS = 1 << 20
+_PACK_MIN_ELEMS = 1 << 24  # 16.7M elements = 32 MB bf16 per saved boundary
 
 
 def _pack_meta(shape) -> Optional[Tuple[int, int]]:
     if len(shape) != 4:
         return None
     n, h, w, c = shape
-    if c >= 128 or 128 % c or h * w < _PACK_MIN_PIXELS:
+    if c == 128 or (w * c) % 128 or h * w * c < _PACK_MIN_ELEMS:
         return None
-    p = 128 // c
-    if w % p:
-        return None
-    return (p, c)
+    return (w, c)
 
 
 def _pack_one(x):
     m = _pack_meta(getattr(x, "shape", ()))
     if m is None:
         return x, None
-    p, c = m
-    n, h, w, _ = x.shape
-    return x.reshape(n, h, w // p, p * c), m
+    n, h, w, c = x.shape
+    return x.reshape(n, h, (w * c) // 128, 128), m
 
 
 def _unpack_one(x, m):
     if m is None:
         return x
-    p, c = m
-    n, h, wp, _ = x.shape
-    return x.reshape(n, h, wp * p, c)
+    w, c = m
+    n, h, _, _ = x.shape
+    return x.reshape(n, h, w, c)
 
 
 def _pack_act(y: Act):

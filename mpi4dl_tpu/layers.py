@@ -112,6 +112,17 @@ class Conv2d(Layer):
     padding: Any = None  # None → (k-1)//2 per dim ("same"-style like reference)
     bias: bool = True
     feature_group_count: int = 1
+    # Function-preserving lane padding (0 = off): the conv consumes/produces
+    # activations padded to these channel widths, with the extra kernel
+    # columns/rows ZERO — so padded input channels contribute exact zeros
+    # and padded output channels are exact zeros.  Params keep their true
+    # shapes (autodiff of the pad is a slice, so weight grads are exact).
+    # Purpose: keep narrow mid-channel chains (AmoebaNet bottlenecks,
+    # c/4 ∈ {52,104,156}) on one dense 128-lane layout through a whole op
+    # chain instead of XLA flipping narrow padded tilings around each conv
+    # (the r4 layout-copy mass, PERF_NOTES).
+    lane_pad_in: int = 0
+    lane_pad_out: int = 0
 
     def _geometry(self):
         kh, kw = _pair(self.kernel_size)
@@ -125,18 +136,30 @@ class Conv2d(Layer):
     def init(self, key, in_shape: Shape):
         kh, kw, sh, sw, ph, pw = self._geometry()
         n, h, w, c = in_shape
-        assert c == self.in_channels, f"expected C={self.in_channels}, got {c} in {in_shape}"
-        fan_in = c // self.feature_group_count * kh * kw
+        expect_c = self.lane_pad_in or self.in_channels
+        assert c == expect_c, f"expected C={expect_c}, got {c} in {in_shape}"
+        if self.lane_pad_in or self.lane_pad_out:
+            assert self.feature_group_count == 1, "lane_pad: groups unsupported"
+            assert not self.lane_pad_in or self.lane_pad_in >= self.in_channels, \
+                (self.lane_pad_in, self.in_channels)
+            assert not self.lane_pad_out or self.lane_pad_out >= self.out_channels, \
+                (self.lane_pad_out, self.out_channels)
+        fan_in = self.in_channels // self.feature_group_count * kh * kw
         bound = 1.0 / math.sqrt(fan_in)
         kkey, bkey = jax.random.split(key)
         params = {
-            "kernel": _uniform(kkey, (kh, kw, c // self.feature_group_count, self.out_channels), bound)
+            "kernel": _uniform(
+                kkey,
+                (kh, kw, self.in_channels // self.feature_group_count,
+                 self.out_channels),
+                bound,
+            )
         }
         if self.bias:
             params["bias"] = _uniform(bkey, (self.out_channels,), bound)
         oh = (h + 2 * ph - kh) // sh + 1
         ow = (w + 2 * pw - kw) // sw + 1
-        return params, (n, oh, ow, self.out_channels)
+        return params, (n, oh, ow, self.lane_pad_out or self.out_channels)
 
     @staticmethod
     def _pallas_dispatchable(sp, kh, kw, sh, sw, groups, kernel) -> bool:
@@ -177,19 +200,26 @@ class Conv2d(Layer):
         )
 
     @staticmethod
-    def _pallas_apply(params, x, kernel, pads, has_bias):
+    def _pallas_apply(bias, x, kernel, pads):
         from mpi4dl_tpu.ops.pallas_conv import halo_conv2d_t
 
         if any(p != (0, 0) for p in pads):
             x = jnp.pad(x, pads)
         y = halo_conv2d_t(x, kernel)
-        if has_bias:
-            y = y + params["bias"].astype(y.dtype)
+        if bias is not None:
+            y = y + bias.astype(y.dtype)
         return y
 
     def apply(self, params, x, ctx: ApplyCtx):
         kh, kw, sh, sw, ph, pw = self._geometry()
         kernel = params["kernel"].astype(x.dtype)
+        bias = params["bias"] if self.bias else None
+        if self.lane_pad_in or self.lane_pad_out:
+            pi = max(0, (self.lane_pad_in or self.in_channels) - self.in_channels)
+            po = max(0, (self.lane_pad_out or self.out_channels) - self.out_channels)
+            kernel = jnp.pad(kernel, ((0, 0), (0, 0), (0, pi), (0, po)))
+            if bias is not None and po:
+                bias = jnp.pad(bias, (0, po))
         sp = ctx.spatial
         if sp is not None and sp.active:
             sharded_h = bool(sp.axis_h) and sp.grid_h > 1
@@ -234,15 +264,15 @@ class Conv2d(Layer):
             # whose margin wasn't realized by halo exchange (all of them in
             # the unsharded case: SAME = pad + margin-consuming VALID).
             return self._pallas_apply(
-                params, x, kernel,
-                [(0, 0), padding[0], padding[1], (0, 0)], self.bias,
+                bias, x, kernel,
+                [(0, 0), padding[0], padding[1], (0, 0)],
             )
         if self._hstripe_shape(kh, kw, sh, sw, self.feature_group_count, x):
             from mpi4dl_tpu.ops.hstripe_conv import hstripe_conv2d
 
             y = hstripe_conv2d(x, kernel, padding[0], padding[1])
-            if self.bias:
-                y = y + params["bias"].astype(y.dtype)
+            if bias is not None:
+                y = y + bias.astype(y.dtype)
             return y
         if ((sh, sw) != (1, 1) and self.feature_group_count == 1
                 and _phase_dx_enabled()):
@@ -261,8 +291,8 @@ class Conv2d(Layer):
                 dimension_numbers=("NHWC", "HWIO", "NHWC"),
                 feature_group_count=self.feature_group_count,
             )
-        if self.bias:
-            y = y + params["bias"].astype(y.dtype)
+        if bias is not None:
+            y = y + bias.astype(y.dtype)
         return y
 
 
@@ -293,15 +323,25 @@ class BatchNorm(Layer):
     num_features: int
     eps: float = 1e-5
     momentum: float = 0.1
+    # Function-preserving lane padding (see Conv2d.lane_pad_*): the layer
+    # normalizes an activation padded to this channel width.  Padded
+    # channels get scale 0 / bias 0, so their output is exactly 0 (the
+    # batch statistics of a zero channel never reach the output); params
+    # and running stats keep the true num_features width.
+    lane_pad: int = 0
 
     def init(self, key, in_shape: Shape):
         c = in_shape[-1]
-        assert c == self.num_features, f"expected C={self.num_features}, got {in_shape}"
+        assert not self.lane_pad or self.lane_pad >= self.num_features, \
+            (self.lane_pad, self.num_features)
+        expect_c = self.lane_pad or self.num_features
+        assert c == expect_c, f"expected C={expect_c}, got {in_shape}"
+        nf = self.num_features
         params = {
-            "scale": jnp.ones((c,), jnp.float32),
-            "bias": jnp.zeros((c,), jnp.float32),
-            "mean": jnp.zeros((c,), jnp.float32),
-            "var": jnp.ones((c,), jnp.float32),
+            "scale": jnp.ones((nf,), jnp.float32),
+            "bias": jnp.zeros((nf,), jnp.float32),
+            "mean": jnp.zeros((nf,), jnp.float32),
+            "var": jnp.ones((nf,), jnp.float32),
         }
         return params, in_shape
 
@@ -316,6 +356,9 @@ class BatchNorm(Layer):
         # the forward temp and the backward cotangents stay bf16 under
         # bf16 compute.
         orig_dtype = x.dtype
+        pad = (self.lane_pad - self.num_features) if self.lane_pad else 0
+        scale = jnp.pad(params["scale"], (0, pad)) if pad else params["scale"]
+        bias = jnp.pad(params["bias"], (0, pad)) if pad else params["bias"]
         if ctx.train:
             axes = tuple(range(x.ndim - 1))  # all but channel
             sp = ctx.spatial
@@ -331,12 +374,15 @@ class BatchNorm(Layer):
                 mh = sp.pre_margin_h if (sp.axis_h and sp.grid_h > 1) else 0
                 mw = sp.pre_margin_w if (sp.axis_w and sp.grid_w > 1) else 0
                 stat_x = x[:, mh : x.shape[1] - mh, mw : x.shape[2] - mw, :]
+            # Accumulate in fp32 for bf16/fp32 activations; promote to f64
+            # under x64 inputs (keeps f64 runs genuinely f64 end-to-end).
+            acc_dt = jnp.promote_types(jnp.float32, x.dtype)
             cnt = jnp.asarray(
-                math.prod([stat_x.shape[a] for a in axes]), jnp.float32
+                math.prod([stat_x.shape[a] for a in axes]), acc_dt
             )
-            s = jnp.sum(stat_x, axis=axes, dtype=jnp.float32)
+            s = jnp.sum(stat_x, axis=axes, dtype=acc_dt)
             ss = jnp.sum(
-                jnp.square(stat_x.astype(jnp.float32)), axis=axes
+                jnp.square(stat_x.astype(acc_dt)), axis=axes
             )
             if sp is not None and sp.active and sp.bn_cross_tile:
                 # Cross-tile statistics: psum local (count, sum, sumsq).
@@ -348,19 +394,26 @@ class BatchNorm(Layer):
             # E[x²]-E[x]² cancellation can go slightly negative in fp.
             var = jnp.maximum(ss / cnt - mean * mean, 0.0)
             if ctx.bn_sink is not None:
-                self._deposit_running(params, mean, var, cnt, ctx)
+                nf = self.num_features
+                self._deposit_running(
+                    params, mean[:nf] if pad else mean,
+                    var[:nf] if pad else var, cnt, ctx,
+                )
         else:
             # Eval has no backward and therefore no activation-memory
             # pressure — keep the affine in fp32 (ADVICE r3: the folded
             # compute-dtype fma is a training-memory lever only; inference
             # outputs keep full precision).
             mean, var = params["mean"], params["var"]
-            inv = lax.rsqrt(var + self.eps) * params["scale"]
-            y = x.astype(jnp.float32) * inv + (params["bias"] - mean * inv)
+            if pad:
+                mean = jnp.pad(mean, (0, pad))
+                var = jnp.pad(var, (0, pad), constant_values=1.0)
+            inv = lax.rsqrt(var + self.eps) * scale
+            y = x.astype(jnp.float32) * inv + (bias - mean * inv)
             return y.astype(orig_dtype)
-        inv = lax.rsqrt(var + self.eps) * params["scale"]
+        inv = lax.rsqrt(var + self.eps) * scale
         a = inv.astype(orig_dtype)
-        b = (params["bias"] - mean * inv).astype(orig_dtype)
+        b = (bias - mean * inv).astype(orig_dtype)
         return x * a + b
 
     def _deposit_running(self, params, mean, var, cnt, ctx: ApplyCtx):

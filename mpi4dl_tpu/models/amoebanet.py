@@ -43,12 +43,31 @@ from mpi4dl_tpu.layers import (
 # ---------------------------------------------------------------------------
 
 
-def _relu_conv_bn(in_c: int, out_c: int, kernel=1, stride=1, padding=0) -> List[Layer]:
+def _relu_conv_bn(in_c: int, out_c: int, kernel=1, stride=1, padding=0,
+                  pad_in: int = 0, pad_out: int = 0) -> List[Layer]:
+    """relu → conv → bn. ``pad_in``/``pad_out`` thread function-preserving
+    lane padding (layers.Conv2d lane_pad_*) through the chain: the conv's
+    zero-padded channels stay exact zeros through BN (scale pad 0) and ReLU,
+    so a whole bottleneck runs on one dense 128-lane width."""
     return [
         ReLU(),
-        Conv2d(in_c, out_c, kernel_size=kernel, stride=stride, padding=padding, bias=False),
-        BatchNorm(out_c),
+        Conv2d(in_c, out_c, kernel_size=kernel, stride=stride,
+               padding=padding, bias=False,
+               lane_pad_in=pad_in, lane_pad_out=pad_out),
+        BatchNorm(out_c, lane_pad=pad_out),
     ]
+
+
+def _lane_pad(c: int) -> int:
+    """Padded width for a bottleneck mid-channel under MPI4DL_LANE_PAD=1
+    (0 = disabled / already a multiple of 128).  Opt-in perf experiment:
+    trades zero-weight FLOPs for one dense layout through the chain
+    (judged on img/s, not mfu — flops_per_step counts the padding)."""
+    import os
+
+    if os.environ.get("MPI4DL_LANE_PAD") != "1" or c % 128 == 0:
+        return 0
+    return ((c + 127) // 128) * 128
 
 
 @dataclasses.dataclass
@@ -112,10 +131,11 @@ def op_conv_1x1(c: int, stride: int) -> Cell:
 
 def op_conv_3x3(c: int, stride: int) -> Cell:
     # Bottleneck form c → c/4 → c (reference amoebanet.py:252-287)
+    m, pm = c // 4, _lane_pad(c // 4)
     return LayerCell(
-        _relu_conv_bn(c, c // 4, 1, 1, 0)
-        + _relu_conv_bn(c // 4, c // 4, 3, stride, 1)
-        + _relu_conv_bn(c // 4, c, 1, 1, 0),
+        _relu_conv_bn(c, m, 1, 1, 0, pad_out=pm)
+        + _relu_conv_bn(m, m, 3, stride, 1, pad_in=pm, pad_out=pm)
+        + _relu_conv_bn(m, c, 1, 1, 0, pad_in=pm),
         name="conv_3x3",
     )
 
@@ -123,11 +143,12 @@ def op_conv_3x3(c: int, stride: int) -> Cell:
 def op_conv_1x7_7x1(c: int, stride: int) -> Cell:
     # c → c/4 → (1,7) → (7,1) → c with stride applied once per image dim
     # (reference amoebanet.py:147-243)
+    m, pm = c // 4, _lane_pad(c // 4)
     return LayerCell(
-        _relu_conv_bn(c, c // 4, 1, 1, 0)
-        + _relu_conv_bn(c // 4, c // 4, (1, 7), (1, stride), (0, 3))
-        + _relu_conv_bn(c // 4, c // 4, (7, 1), (stride, 1), (3, 0))
-        + _relu_conv_bn(c // 4, c, 1, 1, 0),
+        _relu_conv_bn(c, m, 1, 1, 0, pad_out=pm)
+        + _relu_conv_bn(m, m, (1, 7), (1, stride), (0, 3), pad_in=pm, pad_out=pm)
+        + _relu_conv_bn(m, m, (7, 1), (stride, 1), (3, 0), pad_in=pm, pad_out=pm)
+        + _relu_conv_bn(m, c, 1, 1, 0, pad_in=pm),
         name="conv_1x7_7x1",
     )
 

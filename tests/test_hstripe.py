@@ -147,11 +147,11 @@ def test_boundary_packing_exact(monkeypatch, remat):
     from mpi4dl_tpu.models.resnet import get_resnet_v2
     from mpi4dl_tpu.train import Optimizer, TrainState, make_train_step
 
-    monkeypatch.setattr(C, "_PACK_MIN_PIXELS", 1)
+    monkeypatch.setattr(C, "_PACK_MIN_ELEMS", 1)
     model = get_resnet_v2((2, 32, 32, 3), depth=11, num_classes=10)
     params, _ = model.init(jax.random.key(0))
-    # The gate really engages at these shapes (C=16..64 all divide 128).
-    assert C._pack_meta((2, 32, 32, 16)) == (8, 16)
+    # The gate really engages at these shapes (W*C = 512, a 128-multiple).
+    assert C._pack_meta((2, 32, 32, 16)) == (32, 16)
     opt = Optimizer("sgd", lr=0.01)
     x = jax.random.normal(jax.random.key(1), (2, 32, 32, 3))
     y = jnp.arange(2, dtype=jnp.int32)
@@ -289,12 +289,14 @@ def test_resblock_v2_striped_trains(monkeypatch):
 def test_pack_meta_gates():
     from mpi4dl_tpu import cells as C
 
-    # Below the pixel gate: no packing.
+    # Below the size gate: no packing.
     assert C._pack_meta((1, 8, 8, 16)) is None
-    big = C._PACK_MIN_PIXELS
-    # C >= 128 or non-divisor channels: no packing.
+    big = C._PACK_MIN_ELEMS
+    # Exactly 128 lanes already, or W*C not a 128-multiple: no packing.
     assert C._pack_meta((1, big, 1, 128)) is None
     assert C._pack_meta((1, big, 1, 48)) is None
-    # W must divide by the pack factor.
     assert C._pack_meta((1, big, 3, 64)) is None
-    assert C._pack_meta((1, big, 4, 64)) == (2, 64)
+    assert C._pack_meta((1, big, 4, 64)) == (4, 64)
+    # New in r5 (the AmoebaNet frontier masses): C > 128 packs too.
+    assert C._pack_meta((1, 416, 416, 1664)) == (416, 1664)
+    assert C._pack_meta((1, 2048, 2048, 208)) == (2048, 208)
