@@ -75,7 +75,9 @@ LADDER = [
 
 _REMAT = {"none": False, "cell": True, "fine": "fine", "sqrt": "sqrt"}
 
-PROBE_TIMEOUT_S = 1200
+# 1800 not 1200: the 3072px fine-remat first compile outran 1200 s in r5
+# (probe budget is still clamped to the remaining bench deadline).
+PROBE_TIMEOUT_S = 1800
 # Global wall-clock budget: the memory rungs/probe stop (and the headline
 # JSON still prints) once exceeded — a slow tunnel must not starve the
 # driver of the one JSON line it records.
@@ -194,6 +196,27 @@ def _build_step(image_size: int, num_layers: int, num_filters: int,
     )
     state = TrainState.create(params, opt)
     return step, state
+
+
+def build_probe_setup(image_size, num_layers, num_filters, batch,
+                      remat="none", scan=1, arch="amoeba"):
+    """(step, state, x, y) for a rung config — shared by the diagnostic
+    probes (benchmarks/layout_probe.py, benchmarks/mem_probe.py) so their
+    input conventions (bf16 inputs, scan-stacked leading dim) cannot drift
+    from the bench's own rungs."""
+    import jax
+    import jax.numpy as jnp
+
+    step, state = _build_step(
+        image_size, num_layers, num_filters, batch, remat=_REMAT[remat],
+        scan=scan, arch=arch,
+    )
+    shp = (batch, image_size, image_size, 3)
+    if scan > 1:
+        shp = (scan,) + shp
+    x = jax.random.normal(jax.random.key(0), shp, jnp.bfloat16)
+    y = jnp.zeros((scan, batch) if scan > 1 else (batch,), jnp.int32)
+    return step, state, x, y
 
 
 def _step_flops(step, state, x, y) -> float | None:
@@ -396,6 +419,12 @@ def _stderr_gist(stderr: str) -> str:
 
 def _run_sub(argv_tail, timeout_s, platform="tpu"):
     env = dict(os.environ)
+    # Persistent compilation cache shared by every rung/probe subprocess:
+    # a re-probe of a config this round already compiled (e.g. the 3072px
+    # fine-remat attempt, whose first compile outran the r5 probe budget)
+    # hits the cache instead of re-paying a multi-minute compile.
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/mpi4dl_tpu_bench_cache")
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "10")
     if platform == "cpu":
         env.pop("PALLAS_AXON_POOL_IPS", None)
         env["JAX_PLATFORMS"] = "cpu"
@@ -514,17 +543,26 @@ def _max_trainable_px(start: int = 2048, cap: int = 8192,
         print(f"[bench] probe {px}px: {'fits' if ok else 'FAILS'}", file=sys.stderr)
         return ok
 
-    best, px = known_fit, max(start, known_fit * 2)
+    best, px, fail_at = known_fit, max(start, known_fit * 2), None
     while px <= cap:
         if not fits(px):
+            fail_at = px
             break
         best, px = px, px * 2
-    if best and best < cap:
-        # midpoint of [best, min(2*best, cap)], /64-aligned, within the cap
-        mid = min((best * 3) // 2, cap)
-        mid -= mid % 64
-        if mid > best and fits(mid):
-            best = mid
+    if best and (fail_at or best < cap):
+        # Bounded bisection of [best, first-failure) on /64-aligned values —
+        # a single midpoint stops at 3072 and never reaches the 3328-class
+        # frontier the r4 manual probes charted (VERDICT r4 task 6).
+        lo, hi = best, (fail_at or cap)
+        while hi - lo >= 512:
+            mid = ((lo + hi) // 2) - (((lo + hi) // 2) % 64)
+            if mid <= lo or mid >= hi:
+                break
+            if fits(mid):
+                lo = mid
+            else:
+                hi = mid
+        best = lo
     return best, attempts
 
 

@@ -78,21 +78,16 @@ def layout_str(dt: str, dims, order, tiles) -> str:
 
 def probe(args) -> None:
     import jax
-    import jax.numpy as jnp
 
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    from bench import _REMAT, _build_step
+    from bench import build_probe_setup
 
     dev = jax.devices()[0]
     print(f"[layout_probe] device={dev}", file=sys.stderr)
-    step, state = _build_step(
+    step, state, x, y = build_probe_setup(
         args.image_size, args.num_layers, args.num_filters, args.batch,
-        remat=_REMAT[args.remat], scan=1, arch=args.arch,
+        remat=args.remat, scan=1, arch=args.arch,
     )
-    x = jax.random.normal(
-        jax.random.key(0),
-        (args.batch, args.image_size, args.image_size, 3), jnp.bfloat16)
-    y = jnp.zeros((args.batch,), jnp.int32)
     compiled = step.lower(state, x, y).compile()
     hlo = compiled.as_text()
     if args.dump:
@@ -104,14 +99,28 @@ def probe(args) -> None:
 
 
 def analyze_text(hlo: str, top: int) -> None:
-    # Map instruction name -> its result-shape text (for operand lookup).
-    shape_of = {}
+    # Map instruction name -> result-shape text, SCOPED per computation:
+    # HLO instruction names (param_0, copy.1, ...) repeat across fusion
+    # computations, so a module-wide map would misattribute operand
+    # layouts.  A computation starts at "<name> {" (possibly prefixed by
+    # ENTRY/%) and ends at its closing "}" line.
     inst_re = re.compile(r"^\s*(?:ROOT\s+)?%?([\w.\-]+) = (.+)$")
+    comp_re = re.compile(r"^\s*(?:ENTRY\s+)?%?([\w.\-]+)\s.*\{\s*$")
     lines = hlo.splitlines()
+    scopes = {None: {}}
+    comp_of_line = []
+    cur = None
     for ln in lines:
+        cm = comp_re.match(ln)
+        if cm and " = " not in ln:
+            cur = cm.group(1)
+            scopes.setdefault(cur, {})
+        elif ln.strip() == "}":
+            cur = None
+        comp_of_line.append(cur)
         m = inst_re.match(ln)
         if m:
-            shape_of[m.group(1)] = m.group(2)
+            scopes.setdefault(cur, {})[m.group(1)] = m.group(2)
 
     convert_bytes = defaultdict(int)
     convert_count = defaultdict(int)
@@ -121,13 +130,14 @@ def analyze_text(hlo: str, top: int) -> None:
         r"\(%?([\w.\-]+)", )
     meta_re = re.compile(r'op_name="([^"]*)"')
     total = 0
-    for ln in lines:
+    for ln_idx, ln in enumerate(lines):
         m = copy_re.match(ln)
         if not m:
             continue
         name, res_text, kind, operand = m.groups()
         res = parse_shape(res_text)
-        src_text = shape_of.get(operand, "")
+        scope = scopes.get(comp_of_line[ln_idx], {})
+        src_text = scope.get(operand) or scopes[None].get(operand, "")
         src = parse_shape(src_text)
         if res is None:
             continue

@@ -32,23 +32,15 @@ def main() -> None:
     args = p.parse_args()
 
     import jax
-    import jax.numpy as jnp
 
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    from bench import _REMAT, _build_step
+    from bench import build_probe_setup
 
     dev = jax.devices()[0]
     print(f"[mem_probe] device={dev}", file=sys.stderr)
-    step, state = _build_step(
+    step, state, x, y = build_probe_setup(
         args.image_size, args.num_layers, args.num_filters, args.batch,
-        remat=_REMAT[args.remat], scan=args.scan, arch=args.arch,
-    )
-    shp = (args.batch, args.image_size, args.image_size, 3)
-    if args.scan > 1:
-        shp = (args.scan,) + shp
-    x = jax.random.normal(jax.random.key(0), shp, jnp.bfloat16)
-    y = jnp.zeros(
-        (args.scan, args.batch) if args.scan > 1 else (args.batch,), jnp.int32
+        remat=args.remat, scan=args.scan, arch=args.arch,
     )
     t0 = time.perf_counter()
     compiled = step.lower(state, x, y).compile()

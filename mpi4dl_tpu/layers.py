@@ -416,6 +416,22 @@ class BatchNorm(Layer):
         b = (bias - mean * inv).astype(orig_dtype)
         return x * a + b
 
+    def normalize_with_stats(self, params, x, mean, var, cnt, ctx: ApplyCtx):
+        """Train-mode normalization with externally computed batch
+        statistics — the fused Pallas relu-conv-bn epilogue path
+        (ops/pallas_conv.fused_relu_conv_bn_t computes (sum, sumsq) in the
+        conv kernel; the caller turns them into mean/var, cross-tile
+        psum'd when required).  Running-stat deposit and the folded
+        compute-dtype fma are identical to apply()'s train path.
+        ``lane_pad`` is unsupported here (the fused dispatch gates it)."""
+        assert not self.lane_pad, "fused-stats path does not support lane_pad"
+        if ctx.bn_sink is not None:
+            self._deposit_running(params, mean, var, cnt, ctx)
+        inv = lax.rsqrt(var + self.eps) * params["scale"]
+        a = inv.astype(x.dtype)
+        b = (params["bias"] - mean * inv).astype(x.dtype)
+        return x * a + b
+
     def _deposit_running(self, params, mean, var, cnt, ctx: ApplyCtx):
         """Put momentum-updated running stats into ctx.bn_sink.
 

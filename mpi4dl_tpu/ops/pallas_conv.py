@@ -39,7 +39,7 @@ def _round_up(n: int, m: int) -> int:
 
 
 def _kernel(x_any, w_any, o_ref, xwin, wbuf, acc, sem, wsem,
-            *, kh, kw, th, tw, tww, tco):
+            *, kh, kw, th, tw, tww, tco, relu=False):
     """One (H-tile, W-tile, Cout-tile) program.
 
     The input window carries the FULL Cin depth — deep layers shrink the H
@@ -80,6 +80,12 @@ def _kernel(x_any, w_any, o_ref, xwin, wbuf, acc, sem, wsem,
     def _():
         win_copy.start()
         win_copy.wait()
+        if relu:
+            # Fused ReLU prologue: one VMEM-local pass over the window
+            # (margins included — elementwise, identical to relu-then-conv).
+            # A plain vector write AFTER the DMA wait: ordinary dataflow
+            # ordering, not the DMA-vs-vector hazard documented above.
+            xwin[:] = jnp.maximum(xwin[:], 0)
 
     w_copy.wait()
     acc[:] = jnp.zeros_like(acc)
@@ -90,6 +96,29 @@ def _kernel(x_any, w_any, o_ref, xwin, wbuf, acc, sem, wsem,
                 xs, wbuf[dy, dx], preferred_element_type=jnp.float32
             )
     o_ref[:] = acc[:].reshape(th, tw, tco).astype(o_ref.dtype)
+
+
+def _kernel_stats(x_any, w_any, o_ref, s_ref, sq_ref, xwin, wbuf, acc, sem,
+                  wsem, *, kh, kw, th, tw, tww, tco, relu, win):
+    """The fused-epilogue variant: conv (+ optional ReLU prologue) plus
+    per-program partial BN statistics of the CAST output over the static
+    stat window ``win`` = (h0, h1, w0, w1) in out coords (excludes padding
+    and any not-yet-consumed D2 margin, mirroring BatchNorm's stat_x
+    slicing).  Statistics are taken over the cast (compute-dtype) output
+    with fp32 accumulation — the same numbers the unfused BatchNorm
+    computes from the conv's output tensor."""
+    _kernel(x_any, w_any, o_ref, xwin, wbuf, acc, sem, wsem,
+            kh=kh, kw=kw, th=th, tw=tw, tww=tww, tco=tco, relu=relu)
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    h0, h1, w0, w1 = win
+    ri = jax.lax.broadcasted_iota(jnp.int32, (th, tw), 0) + i * th
+    ci = jax.lax.broadcasted_iota(jnp.int32, (th, tw), 1) + j * tw
+    valid = (ri >= h0) & (ri < h1) & (ci >= w0) & (ci < w1)
+    yf = o_ref[:].astype(jnp.float32)
+    yv = jnp.where(valid[:, :, None], yf, 0.0)
+    s_ref[0, 0, :] = jnp.sum(yv, axis=(0, 1))
+    sq_ref[0, 0, :] = jnp.sum(yv * yv, axis=(0, 1))
 
 
 # Per-program VMEM budget for the input-window scratch (bytes); the H tile
@@ -153,7 +182,10 @@ def pallas_conv_eligible(cin: int, cout: int | None = None, kh: int = 3,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("th", "tw", "tco", "interpret", "out_dtype")
+    jax.jit, static_argnames=(
+        "th", "tw", "tco", "interpret", "out_dtype", "fuse_relu",
+        "stat_window",
+    )
 )
 def halo_conv2d(
     x: jax.Array,
@@ -163,7 +195,9 @@ def halo_conv2d(
     tco: int = _DEFAULT_TCO,
     out_dtype=None,
     interpret: bool = False,
-) -> jax.Array:
+    fuse_relu: bool = False,
+    stat_window=None,
+):
     """VALID stride-1 conv consuming a pre-exchanged margin.
 
     x: [N, H + kh-1, W + kw-1, Cin] (margin already present — halo-exchanged
@@ -173,6 +207,13 @@ def halo_conv2d(
     ``th`` is an upper bound: it halves until the full-Cin input window fits
     the VMEM budget (Cin is never chunked — see the WAR-hazard note on
     ``_kernel``).
+
+    ``fuse_relu`` applies ReLU to the input window in VMEM (one pass, no
+    HBM round-trip for the pre-activation).  ``stat_window=(h0,h1,w0,w1)``
+    (out coords) additionally returns fp32 partial BN statistics
+    ``(y, sum, sumsq)`` of the cast output over that window, summed over
+    batch/tiles to shape [Cout] — the epilogue that lets the kernel compete
+    with XLA's conv+BN+ReLU fusion at step level (VERDICT r4 task 5).
     """
     n, hp, wp, cin = x.shape
     kh, kw, wcin, cout = w.shape
@@ -221,35 +262,68 @@ def halo_conv2d(
     grid = (h_p // th, w_p // tw, cout_p // tco)
     # Under shard_map with vma checking, pallas_call must declare how its
     # output varies across mesh axes: the union of the inputs' vma.
-    try:
-        vma = frozenset(jax.typeof(x).vma) | frozenset(jax.typeof(w).vma)
-        out_struct = jax.ShapeDtypeStruct((h_p, w_p, cout_p), out_dtype, vma=vma)
-    except (AttributeError, TypeError):
-        out_struct = jax.ShapeDtypeStruct((h_p, w_p, cout_p), out_dtype)
+    def _struct(shape, dtype):
+        try:
+            vma = frozenset(jax.typeof(x).vma) | frozenset(jax.typeof(w).vma)
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+        except (AttributeError, TypeError):
+            return jax.ShapeDtypeStruct(shape, dtype)
+
+    scratch = [
+        pltpu.VMEM((th + kh - 1, tww, cin_p), x.dtype),
+        pltpu.VMEM((kh, kw, cin_p, tco), w.dtype),
+        pltpu.VMEM((th * tw, tco), jnp.float32),
+        pltpu.SemaphoreType.DMA,
+        pltpu.SemaphoreType.DMA,
+    ]
+    in_specs = [
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    o_spec = pl.BlockSpec(
+        (th, tw, tco), lambda i, j, c: (i, j, c), memory_space=pltpu.VMEM
+    )
+    if stat_window is None:
+        call = pl.pallas_call(
+            functools.partial(
+                _kernel, kh=kh, kw=kw, th=th, tw=tw, tww=tww, tco=tco,
+                relu=fuse_relu,
+            ),
+            out_shape=_struct((h_p, w_p, cout_p), out_dtype),
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=o_spec,
+            scratch_shapes=scratch,
+            interpret=interpret,
+        )
+        y = jax.vmap(call, in_axes=(0, None))(x_p, w_pd)
+        return y[:, :h, :wid, :cout]
+    stat_shape = (grid[0], grid[1], cout_p)
+    stat_spec = pl.BlockSpec(
+        (1, 1, tco), lambda i, j, c: (i, j, c), memory_space=pltpu.VMEM
+    )
     call = pl.pallas_call(
         functools.partial(
-            _kernel, kh=kh, kw=kw, th=th, tw=tw, tww=tww, tco=tco,
+            _kernel_stats, kh=kh, kw=kw, th=th, tw=tw, tww=tww, tco=tco,
+            relu=fuse_relu, win=tuple(stat_window),
         ),
-        out_shape=out_struct,
+        out_shape=(
+            _struct((h_p, w_p, cout_p), out_dtype),
+            _struct(stat_shape, jnp.float32),
+            _struct(stat_shape, jnp.float32),
+        ),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
-        out_specs=pl.BlockSpec(
-            (th, tw, tco), lambda i, j, c: (i, j, c), memory_space=pltpu.VMEM
-        ),
-        scratch_shapes=[
-            pltpu.VMEM((th + kh - 1, tww, cin_p), x.dtype),
-            pltpu.VMEM((kh, kw, cin_p, tco), w.dtype),
-            pltpu.VMEM((th * tw, tco), jnp.float32),
-            pltpu.SemaphoreType.DMA,
-            pltpu.SemaphoreType.DMA,
-        ],
+        in_specs=in_specs,
+        out_specs=(o_spec, stat_spec, stat_spec),
+        scratch_shapes=scratch,
         interpret=interpret,
     )
-    y = jax.vmap(call, in_axes=(0, None))(x_p, w_pd)
-    return y[:, :h, :wid, :cout]
+    y, s, ss = jax.vmap(call, in_axes=(0, None))(x_p, w_pd)
+    return (
+        y[:, :h, :wid, :cout],
+        jnp.sum(s, axis=(0, 1, 2))[:cout],
+        jnp.sum(ss, axis=(0, 1, 2))[:cout],
+    )
 
 
 def conv_flops(n: int, h: int, w: int, cin: int, cout: int, kh: int, kw: int) -> int:
@@ -316,3 +390,70 @@ def _bwd(interpret, res, ct):
 
 
 halo_conv2d_t.defvjp(_fwd, _bwd)
+
+
+# ---------------------------------------------------------------------------
+# Fused relu→conv→BN-stats op (VERDICT r4 task 5: the kernel's one fair shot
+# against XLA's conv+BN+ReLU fusion at step level).
+#
+#   (y, s, ss) = (conv(relu(x), w),
+#                 Σ_win cast(y),  Σ_win cast(y)²)      win ⊂ out coords
+#
+# The ReLU rides the window DMA (no HBM pass for the pre-activation) and the
+# statistics ride the accumulator cast (no re-read of y for BN's reduce).
+# VJP (manual, no primal recompute):
+#   dy_total = ct_y + 1_win·(ct_s + 2·y·ct_ss)
+#   dx       = relu'(x) ⊙ conv(pad(dy_total), flip+swap(w))
+#   dw       = conv-backprop-filter(relu(x), dy_total)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def fused_relu_conv_bn_t(x: jax.Array, w: jax.Array, stat_window,
+                         interpret: bool = False):
+    """Trainable fused op: returns ``(y, sum, sumsq)`` with y = conv(relu(x),
+    w) (VALID, margin-consuming) and fp32 statistics of the cast output over
+    ``stat_window`` = (h0, h1, w0, w1) in out coords."""
+    return halo_conv2d(
+        x, w, interpret=_auto_interpret(interpret), fuse_relu=True,
+        stat_window=tuple(stat_window),
+    )
+
+
+def _fused_fwd(x, w, stat_window, interpret):
+    y, s, ss = fused_relu_conv_bn_t(x, w, stat_window, interpret)
+    return (y, s, ss), (x, w, y)
+
+
+def _fused_bwd(stat_window, interpret, res, cts):
+    x, w, y = res
+    ct_y, ct_s, ct_ss = cts
+    h0, h1, w0, w1 = stat_window
+    # Statistics backward: only the stat window receives the broadcast
+    # ct_s and the 2·y·ct_ss term (fp32, then back to the compute dtype).
+    y_win = y[:, h0:h1, w0:w1, :].astype(jnp.float32)
+    dwin = ct_s[None, None, None, :] + 2.0 * y_win * ct_ss[None, None, None, :]
+    dy = ct_y.astype(jnp.float32)
+    dy = dy.at[:, h0:h1, w0:w1, :].add(dwin)
+    dy = dy.astype(ct_y.dtype)
+    # Conv backward — same structure as _bwd, plus the ReLU mask on dx and
+    # relu(x) as the dw primal.
+    kh, kw = w.shape[0], w.shape[1]
+    ct_pad = jnp.pad(dy, ((0, 0), (kh - 1, kh - 1), (kw - 1, kw - 1), (0, 0)))
+    w_t = jnp.flip(w, axis=(0, 1)).swapaxes(2, 3)
+    if pallas_conv_eligible(w_t.shape[2], None, kh, kw, _DEFAULT_TCO,
+                            dy.dtype.itemsize):
+        dx_lin = halo_conv2d(
+            ct_pad, w_t.astype(dy.dtype), out_dtype=x.dtype,
+            interpret=_auto_interpret(interpret),
+        )
+    else:
+        dx_lin = _lax_valid_conv(ct_pad, w_t.astype(dy.dtype)).astype(x.dtype)
+    dx = jnp.where(x > 0, dx_lin, jnp.zeros((), dx_lin.dtype))
+    xr = jax.nn.relu(x)
+    w_t_fn = jax.linear_transpose(lambda w_: _lax_valid_conv(xr, w_), w)
+    (dw,) = w_t_fn(dy.astype(xr.dtype))
+    return dx, dw
+
+
+fused_relu_conv_bn_t.defvjp(_fused_fwd, _fused_bwd)

@@ -37,16 +37,18 @@ def _fake_runner(fits_px):
 
 
 def test_max_trainable_px_doubling_and_midpoint(bench, monkeypatch):
-    """2048 seed fits, 4096 fails -> midpoint 3072 probed; exactly the
-    attempt sequence the real TPU run takes."""
+    """2048 seed fits, 4096 fails -> bisection probes 3072, 3584, 3328 (the
+    r4-charted frontier) and lands on the 3328-class answer."""
     runner = _fake_runner(fits_px=3500)
     monkeypatch.setattr(bench, "_run_sub", runner)
     best, attempts = bench._max_trainable_px(start=4096, known_fit=2048)
-    assert best == 3072
-    assert runner.calls == [4096, 3072]
+    assert best == 3328
+    assert runner.calls == [4096, 3072, 3584, 3328]
     assert attempts["4096"]["ok"] is False
     assert "Ran out of memory" in attempts["4096"]["error"]
     assert attempts["3072"]["ok"] is True
+    assert attempts["3328"]["ok"] is True
+    assert attempts["3584"]["ok"] is False
 
 
 def test_max_trainable_px_full_ladder(bench, monkeypatch):

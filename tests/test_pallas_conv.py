@@ -263,3 +263,91 @@ def test_single_device_pallas_train_step_matches_plain():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=2e-3, atol=1e-5
         )
+
+
+def test_fused_relu_conv_bn_matches_reference():
+    """fused_relu_conv_bn_t (interpret): y/s/ss and VJP vs the plain
+    composition relu -> VALID conv -> windowed cast-stats, fp32."""
+    from mpi4dl_tpu.ops.pallas_conv import fused_relu_conv_bn_t
+
+    kh = kw = 3
+    n, h, w_, cin, cout = 2, 12, 10, 8, 16
+    win = (1, h - 1, 2, w_ - 2)  # a margin-excluding stat window
+    x = jax.random.normal(jax.random.key(0), (n, h + kh - 1, w_ + kw - 1, cin))
+    wk = jax.random.normal(jax.random.key(1), (kh, kw, cin, cout)) * 0.1
+
+    def ref(x, wk):
+        y = jax.lax.conv_general_dilated(
+            jax.nn.relu(x), wk, (1, 1), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        yw = y[:, win[0]:win[1], win[2]:win[3], :].astype(jnp.float32)
+        return y, jnp.sum(yw, (0, 1, 2)), jnp.sum(yw * yw, (0, 1, 2))
+
+    got = fused_relu_conv_bn_t(x, wk, win, True)
+    want = ref(x, wk)
+    for g, r in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=1e-4, atol=1e-4)
+
+    # VJP: an arbitrary scalarization touching all three outputs.
+    def scal(f):
+        def s(x, wk):
+            y, sm, ss = f(x, wk)
+            return (jnp.sum(y * 0.3) + jnp.sum(sm * 0.7)
+                    + jnp.sum(ss * 0.11))
+        return s
+
+    gx, gw = jax.grad(scal(lambda a, b: fused_relu_conv_bn_t(a, b, win, True)),
+                      argnums=(0, 1))(x, wk)
+    rx, rw = jax.grad(scal(ref), argnums=(0, 1))(x, wk)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(rx),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(rw),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_premargin_fused_triple_matches_unfused():
+    """apply_layers_premargin with use_pallas_conv: the fused
+    relu-conv-bn window must reproduce the unfused path — values, grads,
+    and BN running-stat deposits (fp32, interpret mode)."""
+    from mpi4dl_tpu.layer_ctx import ApplyCtx, SpatialCtx
+    from mpi4dl_tpu.layers import BatchNorm, Conv2d, ReLU
+    from mpi4dl_tpu.ops.d2 import accumulated_halo, apply_layers_premargin
+
+    c, t, bs = 16, 16, 2
+    layers = []
+    for _ in range(2):
+        layers += [ReLU(), Conv2d(c, c, 3, bias=False), BatchNorm(c)]
+    hh, hw = accumulated_halo(layers)
+    key = jax.random.key(0)
+    params, shape = [], (bs, t, t, c)
+    for i, l in enumerate(layers):
+        pp, shape = l.init(jax.random.fold_in(key, i), shape)
+        params.append(pp)
+    x = jax.random.normal(jax.random.key(1), (bs, t + 2 * hh, t + 2 * hw, c))
+
+    def run(use_pallas):
+        sp = SpatialCtx(
+            axis_h="sph", axis_w="spw", grid_h=2, grid_w=2,
+            bn_cross_tile=False, stat_local=True,
+            use_pallas_conv=use_pallas,
+        )
+        sink = {}
+        ctx = ApplyCtx(train=True, spatial=sp, bn_sink=sink)
+
+        def loss_fn(ps):
+            y, mh, mw = apply_layers_premargin(layers, ps, x, ctx, hh, hw)
+            assert mh == 0 and mw == 0
+            return jnp.mean(jnp.square(y))
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        return loss, grads, sink
+
+    l0, g0, s0 = run(False)
+    l1, g1, s1 = run(True)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+    assert len(s1) == len(s0) > 0  # running-stat deposits happened
