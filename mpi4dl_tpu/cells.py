@@ -57,9 +57,12 @@ class LayerCell(Cell):
         return params, shape
 
     def apply(self, params, x, ctx):
-        from mpi4dl_tpu.ops.d2 import maybe_run_d2
+        from mpi4dl_tpu.ops.d2 import maybe_run_d2, maybe_run_fused_unsharded
 
         y = maybe_run_d2(self.layers, params, x, ctx)
+        if y is not None:
+            return y
+        y = maybe_run_fused_unsharded(self.layers, params, x, ctx)
         if y is not None:
             return y
         for p, layer in zip(params, self.layers):

@@ -257,6 +257,18 @@ class Conv2d(Layer):
             use_pallas = (
                 sp is not None and sp.axis_h is None and sp.axis_w is None
             )
+        # hstripe is checked BEFORE the Pallas opt-in: tiny-channel
+        # huge-spatial convs (ResNet C<=16 at 2048²-class) are the regime
+        # where the kernel's 128-lane channel pad multiplies the input
+        # 8-42x in HBM (measured OOM) — a pallas_conv=True A/B run must
+        # not route them away from the striped path built for them.
+        if self._hstripe_shape(kh, kw, sh, sw, self.feature_group_count, x):
+            from mpi4dl_tpu.ops.hstripe_conv import hstripe_conv2d
+
+            y = hstripe_conv2d(x, kernel, padding[0], padding[1])
+            if bias is not None:
+                y = y + bias.astype(y.dtype)
+            return y
         if use_pallas and self._pallas_dispatchable(
             sp, kh, kw, sh, sw, self.feature_group_count, kernel
         ):
@@ -267,13 +279,6 @@ class Conv2d(Layer):
                 bias, x, kernel,
                 [(0, 0), padding[0], padding[1], (0, 0)],
             )
-        if self._hstripe_shape(kh, kw, sh, sw, self.feature_group_count, x):
-            from mpi4dl_tpu.ops.hstripe_conv import hstripe_conv2d
-
-            y = hstripe_conv2d(x, kernel, padding[0], padding[1])
-            if bias is not None:
-                y = y + bias.astype(y.dtype)
-            return y
         if ((sh, sw) != (1, 1) and self.feature_group_count == 1
                 and _phase_dx_enabled()):
             # Strided convs take the phase-decomposed-backward form: same

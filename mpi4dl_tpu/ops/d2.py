@@ -86,12 +86,19 @@ def can_fuse(layers: Sequence, sp) -> bool:
     return (sharded_h and hh > 0) or (sharded_w and hw > 0)
 
 
-def _fusable_triple(layers, i, x_dtype, train: bool) -> bool:
+def _fusable_triple(layers, i, x_dtype, train: bool,
+                    x_shape=None) -> bool:
     """[ReLU, Conv2d, BatchNorm] starting at i, eligible for the fused
     Pallas relu→conv→BN-stats kernel: stride-1 non-1x1 ungrouped unbiased
     conv, no lane padding, train mode (eval normalizes with running stats —
-    no stats to fuse), VMEM caps OK in both conv directions."""
+    no stats to fuse), VMEM caps OK in both conv directions.  Tiny-channel
+    huge-spatial inputs are excluded (``x_shape`` given): the kernel's
+    128-lane pad multiplies such inputs 8-42x in HBM — that regime belongs
+    to ops/hstripe_conv.py (see Conv2d.apply's dispatch order)."""
     if i + 2 >= len(layers) or not train:
+        return False
+    if (x_shape is not None and len(x_shape) == 4
+            and x_shape[-1] <= 64 and x_shape[1] * x_shape[2] >= (1 << 20)):
         return False
     r, cv, bn = layers[i], layers[i + 1], layers[i + 2]
     if not (type(r) is ReLU and type(cv) is Conv2d and type(bn) is BatchNorm):
@@ -150,6 +157,32 @@ def _apply_fused_triple(cv: Conv2d, bn: BatchNorm, p_conv, p_bn, x, ctx,
     return y, mh2, mw2
 
 
+def maybe_run_fused_unsharded(layers: Sequence, params_seq, x,
+                              ctx: ApplyCtx):
+    """Single-device fused relu→conv→bn dispatch for a plain layer cell.
+
+    The unsharded case is the degenerate premargin run (no margins, SAME =
+    explicit pad + margin-consuming VALID), so [ReLU, Conv2d, BatchNorm]
+    windows can take the same fused Pallas kernel the D2 path uses —
+    gated on the axis-free ``use_pallas_conv`` knob carrier
+    (make_train_step(pallas_conv=True)); returns None (zero graph change)
+    unless at least one fusable window exists and every layer in the cell
+    is premargin-capable."""
+    sp = ctx.spatial
+    if (sp is None or not sp.use_pallas_conv or sp.active
+            or sp.axis_h is not None or sp.axis_w is not None):
+        return None
+    if any(layer_d2_geometry(l) is None for l in layers):
+        return None
+    if not any(
+        _fusable_triple(layers, i, x.dtype, ctx.train, x.shape)
+        for i in range(len(layers))
+    ):
+        return None
+    y, _, _ = apply_layers_premargin(layers, params_seq, x, ctx, 0, 0)
+    return y
+
+
 def apply_layers_premargin(layers: Sequence, params_seq, x, ctx: ApplyCtx,
                            mh: int, mw: int):
     """Apply `layers` to an activation already carrying margin (mh, mw) on the
@@ -169,7 +202,7 @@ def apply_layers_premargin(layers: Sequence, params_seq, x, ctx: ApplyCtx,
     idx = 0
     while idx < len(layers):
         if sp.use_pallas_conv and _fusable_triple(layers, idx, x.dtype,
-                                                  ctx.train):
+                                                  ctx.train, x.shape):
             cv, bn = layers[idx + 1], layers[idx + 2]
             ph, pw, *_ = layer_d2_geometry(cv)
             # Stride is 1 by the gate, so the misalignment checks below are
