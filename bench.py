@@ -783,19 +783,33 @@ def main() -> int:
                 continue
             print(f"[bench] 1024px bs{bs} rung", file=sys.stderr)
             r_b, b_errs = None, []
-            for rm in ("none", "cell"):
+            # OOM ladder: prefer no-remat (backward reads stored
+            # activations, ~21% faster); before surrendering to cell
+            # remat, drop the scan wrapper — its loop-carry
+            # double-buffering costs real GBs (measured ~3.7 GB at 2048²),
+            # which is exactly what pushed r5's bs4 rung into the cell
+            # fallback (3.75 img/s vs bs2's 4.49 at none).
+            tries = [("none", rung_scan), ("none", 1),
+                     ("cell", rung_scan), ("cell", 1)]
+            if rung_scan == 1:
+                tries = [("none", 1), ("cell", 1)]
+            # iters is the RUNG's step count regardless of which scan wins
+            # (it only needs to be a multiple of the active scan, and
+            # rung_scan is): a scan-drop retry must not shrink the sample.
+            iters_b = 2 * bs * rung_scan
+            for rm, t_scan in tries:
                 if _time_left() < 300:
                     b_errs.append(f"{rm}: skipped (bench deadline reached)")
                     break
                 r_b, e = _try_rung(
-                    f"tpu_{bname}", "tpu", 1024, 18, 416, 1, 2 * bs * rung_scan,
+                    f"tpu_{bname}", "tpu", 1024, 18, 416, 1, iters_b,
                     min(1200, max(300, _time_left() - 300)), False, rm, bs,
-                    rung_scan,
+                    t_scan,
                 )
                 if r_b is not None:
                     health.note_success()
                     break
-                b_errs.append(f"{rm}: {e}")
+                b_errs.append(f"{rm}/scan{t_scan}: {e}")
                 if not _re.search(_OOM_RE, e or ""):
                     # Only OOM justifies the remat retry; a hang/backend
                     # failure would just burn the probes' budget.
