@@ -363,3 +363,60 @@ def test_amoeba_cell_d2_remat_ops_matches_plain(devices8):
     )(x)
     np.testing.assert_array_equal(np.asarray(fine[0]), np.asarray(plain[0]))
     np.testing.assert_array_equal(np.asarray(fine[1]), np.asarray(plain[1]))
+
+
+def test_d2_fused_pallas_triple_sharded_matches_unfused(devices8):
+    """The fused relu-conv-bn Pallas path under a REAL shard_map D2 run
+    (vertical 4-tile): values and grads must match the unfused path,
+    including the cross-tile psum of the kernel's BN statistics and the
+    three-output pallas_call's vma declaration (untested anywhere else)."""
+    cell = LayerCell(
+        [ReLU(), Conv2d(8, 8, 3, bias=False), BatchNorm(8),
+         ReLU(), Conv2d(8, 8, 3, bias=False), BatchNorm(8)]
+    )
+    params, _ = cell.init(jax.random.key(0), (2, 16, 16, 8))
+    x = jax.random.normal(jax.random.key(1), (2, 16, 16, 8))
+    mesh = build_mesh(MeshSpec(data=1, stage=1, sph=1, spw=4), jax.devices()[:4])
+
+    from mpi4dl_tpu.ops import d2 as d2mod
+
+    hits = []
+    orig = d2mod._fusable_triple
+
+    def probe(layers, i, dt, train, x_shape=None):
+        r = orig(layers, i, dt, train, x_shape)
+        if r:
+            hits.append(i)
+        return r
+
+    def run(use_pallas):
+        sp = SpatialCtx(axis_w="spw", grid_w=4, d2_mode=True,
+                        use_pallas_conv=use_pallas)
+        ctx = ApplyCtx(train=True, spatial=sp)
+        assert can_fuse(cell.layers, sp)
+
+        def loss_fn(ps, x_tile):
+            y = cell.apply(ps, x_tile, ctx)
+            return jnp.mean(jnp.square(y))
+
+        def fwd(ps, x_tile):
+            loss, grads = jax.value_and_grad(loss_fn)(ps, x_tile)
+            return lax.pmean(loss, "spw"), grads
+
+        spec = P(None, None, "spw", None)
+        return jax.jit(shard_map(
+            fwd, mesh=mesh,
+            in_specs=(P(), spec), out_specs=(P(), P()),
+        ))(params, x)
+
+    l0, g0 = run(False)
+    d2mod._fusable_triple = probe
+    try:
+        l1, g1 = run(True)
+    finally:
+        d2mod._fusable_triple = orig
+    assert hits, "fused dispatch never engaged under shard_map"
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=1e-5)
