@@ -228,8 +228,16 @@ def make_train_step(
     sp_knobs = (
         SpatialCtx(use_pallas_conv=True) if pallas_conv else None
     )
+    import os as _os
+
+    # MPI4DL_REMAT_OPS=1 combines per-op checkpoints with ANY outer remat
+    # level (e.g. sqrt grouping + per-op bounding for the ResNet-2048
+    # memory frontier) — "fine" remains per-cell + per-op.
     ctx = ApplyCtx(
-        train=True, remat_ops=(remat == "fine"), spatial=sp_knobs
+        train=True,
+        remat_ops=(remat == "fine"
+                   or _os.environ.get("MPI4DL_REMAT_OPS") == "1"),
+        spatial=sp_knobs,
     )
     model_remat = "sqrt" if remat == "sqrt" else bool(remat)
     loss_fn = make_loss_fn(

@@ -300,3 +300,35 @@ def test_pack_meta_gates():
     # New in r5 (the AmoebaNet frontier masses): C > 128 packs too.
     assert C._pack_meta((1, 416, 416, 1664)) == (416, 1664)
     assert C._pack_meta((1, 2048, 2048, 208)) == (2048, 208)
+
+
+def test_resnet_branch_remat_ops_exact(monkeypatch):
+    """Per-op checkpoints inside ResNet residual branches (remat_ops via
+    MPI4DL_REMAT_OPS=1 under sqrt grouping — the 2048² frontier config)
+    must match the plain path exactly: losses, params, running stats."""
+    from mpi4dl_tpu import cells as C
+    from mpi4dl_tpu.models.resnet import get_resnet_v2
+    from mpi4dl_tpu.train import Optimizer, TrainState, make_train_step
+
+    monkeypatch.setattr(C, "_PACK_MIN_ELEMS", 1)
+    monkeypatch.setenv("MPI4DL_REMAT_OPS", "1")
+    model = get_resnet_v2((2, 32, 32, 3), depth=11, num_classes=10)
+    params, _ = model.init(jax.random.key(0))
+    opt = Optimizer("sgd", lr=0.01)
+    x = jax.random.normal(jax.random.key(1), (2, 32, 32, 3))
+    y = jnp.arange(2, dtype=jnp.int32)
+    s_r = TrainState.create(params, opt)
+    step_r = make_train_step(model, opt, remat="sqrt")
+    monkeypatch.delenv("MPI4DL_REMAT_OPS")
+    s_o = TrainState.create(params, opt)
+    step_o = make_train_step(model, opt)
+    for _ in range(2):
+        s_r, m_r = step_r(s_r, x, y)
+        s_o, m_o = step_o(s_o, x, y)
+        np.testing.assert_allclose(
+            float(m_r["loss"]), float(m_o["loss"]), rtol=2e-5
+        )
+    for a, b in zip(jax.tree.leaves(s_r.params), jax.tree.leaves(s_o.params)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6
+        )
