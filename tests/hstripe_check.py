@@ -1,0 +1,115 @@
+"""H-striping exactness spot-check on the LIVE chip (VERDICT r4 task 4).
+
+Block-level H-striping (ops/hstripe_conv.hstripe_layer_run) and the
+H-striped conv (hstripe_conv2d) are CPU-exact-tested, but this project has
+twice found TPU-only failures in exactly this code class (8-aligned DMA
+extents; unfenced DMA-vs-vector WAR races — PERF_NOTES).  This script runs
+both striped paths on the real chip at shapes that engage their dispatch
+gates and compares against the plain XLA paths computed on the same chip.
+
+    python tests/hstripe_check.py            # real chip
+    python tests/hstripe_check.py --small    # quick shapes (any host)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def check_conv(h: int, w: int, c: int) -> float:
+    """hstripe_conv2d vs lax.conv on the chip; returns max abs err."""
+    from jax import lax
+
+    from mpi4dl_tpu.ops.hstripe_conv import hstripe_conv2d
+
+    x = jax.random.normal(jax.random.key(0), (1, h, w, c), jnp.bfloat16)
+    k = (jax.random.normal(jax.random.key(1), (3, 3, c, c), jnp.float32)
+         * 0.1).astype(jnp.bfloat16)
+    got = jax.jit(lambda x, k: hstripe_conv2d(x, k, (1, 1), (1, 1)))(x, k)
+    want = jax.jit(lambda x, k: lax.conv_general_dilated(
+        x, k, (1, 1), ((1, 1), (1, 1)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    ))(x, k)
+    return float(jnp.max(jnp.abs(
+        got.astype(jnp.float32) - want.astype(jnp.float32)
+    )))
+
+
+def check_layer_run(h: int, w: int, c: int) -> float:
+    """hstripe_layer_run vs its pad-once emulation (apply_layers_premargin
+    on the unstriped input) — the same oracle tests/test_hstripe.py pins on
+    CPU, here executed on the chip."""
+    import dataclasses
+
+    from mpi4dl_tpu.layer_ctx import ApplyCtx, SpatialCtx
+    from mpi4dl_tpu.layers import BatchNorm, Conv2d, ReLU
+    from mpi4dl_tpu.ops.d2 import accumulated_halo, apply_layers_premargin
+    from mpi4dl_tpu.ops.hstripe_conv import (
+        hstripe_layer_run, hstripe_run_eligible,
+    )
+
+    layers = [
+        BatchNorm(c), ReLU(), Conv2d(c, c, 3, bias=False),
+        BatchNorm(c), ReLU(), Conv2d(c, c, 3, bias=False),
+    ]
+    key = jax.random.key(0)
+    params, shape = [], (1, h, w, c)
+    for i, l in enumerate(layers):
+        pp, shape = l.init(jax.random.fold_in(key, i), shape)
+        params.append(pp)
+    x = jax.random.normal(jax.random.key(1), (1, h, w, c), jnp.bfloat16)
+    ctx = ApplyCtx(train=False)  # eval: stats deviation-free (PERF_NOTES)
+    assert hstripe_run_eligible(layers, x.shape, ctx), "gate must engage"
+
+    got = jax.jit(
+        lambda x: hstripe_layer_run(layers, params, x, ctx)
+    )(x)
+    assert got is not None, "layer-run fell back to the plain path"
+
+    hh, hw = accumulated_halo(layers)
+    sp = SpatialCtx(axis_h="sph", grid_h=2, bn_cross_tile=False,
+                    stat_local=True)
+    ectx = dataclasses.replace(ctx, spatial=sp)
+
+    def emul(x):
+        xp = jnp.pad(x, ((0, 0), (hh, hh), (0, 0), (0, 0)))
+        y, mh, mw = apply_layers_premargin(layers, params, xp, ectx, hh, 0)
+        assert mh == 0, mh
+        return y
+
+    want = jax.jit(emul)(x)
+    return float(jnp.max(jnp.abs(
+        got.astype(jnp.float32) - want.astype(jnp.float32)
+    )))
+
+
+if __name__ == "__main__":
+    small = "--small" in sys.argv
+    dev = jax.devices()[0]
+    print(f"[hstripe_check] device={dev}", file=sys.stderr)
+    h = w = 256 if small else 1536
+    if small:
+        # Quick shapes sit under the production dispatch gates — lower
+        # them so the striped paths still engage.
+        from mpi4dl_tpu import layers as L
+        from mpi4dl_tpu.ops import hstripe_conv as HS
+
+        L._HSTRIPE_MIN_PIXELS = 1
+        HS._RUN_MIN_PIXELS = 1
+        HS._RUN_STRIPE_BUDGET = 64 * 1024  # force multi-stripe at 256²
+    e1 = check_conv(h, w, 16)
+    print(f"hstripe_conv2d {h}x{w}x16: maxerr {e1:.3e}")
+    e2 = check_layer_run(h, w, 16)
+    print(f"hstripe_layer_run {h}x{w}x16: maxerr {e2:.3e}")
+    tol = 0.25  # bf16 compute over C-sized reductions; exactness = same-op
+    if e1 > 0.02 or e2 > tol:
+        print("hstripe_check: FAIL")
+        raise SystemExit(1)
+    print("hstripe_check: PASS")
