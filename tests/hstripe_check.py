@@ -94,16 +94,16 @@ if __name__ == "__main__":
     small = "--small" in sys.argv
     dev = jax.devices()[0]
     print(f"[hstripe_check] device={dev}", file=sys.stderr)
-    h = w = 256 if small else 1536
+    # 2048 = the production gate's regime (_RUN_MIN_PIXELS = 1<<22); the
+    # quick mode lowers the gates/budgets so both striped paths still take
+    # multi-stripe schedules at 256².
+    h = w = 256 if small else 2048
     if small:
-        # Quick shapes sit under the production dispatch gates — lower
-        # them so the striped paths still engage.
-        from mpi4dl_tpu import layers as L
         from mpi4dl_tpu.ops import hstripe_conv as HS
 
-        L._HSTRIPE_MIN_PIXELS = 1
         HS._RUN_MIN_PIXELS = 1
-        HS._RUN_STRIPE_BUDGET = 64 * 1024  # force multi-stripe at 256²
+        HS._RUN_STRIPE_BUDGET = 64 * 1024  # multi-stripe layer run at 256²
+        HS._PATCH_BUDGET = 1024 * 1024     # multi-stripe conv at 256²
     e1 = check_conv(h, w, 16)
     print(f"hstripe_conv2d {h}x{w}x16: maxerr {e1:.3e}")
     e2 = check_layer_run(h, w, 16)

@@ -99,10 +99,11 @@ def test_stderr_gist_python_exception_lines(bench):
     )
 
 
-def test_ladder_clamps_to_deadline(bench, monkeypatch):
+def test_ladder_clamps_to_deadline(bench, monkeypatch, tmp_path):
     """Rung timeouts clamp to the remaining global budget and rungs skip
     entirely once it is spent — the driver always gets its JSON line within
     DEADLINE_S even with two 1800 s headline rungs in the ladder."""
+    monkeypatch.setattr(bench, "MEASURED_PATH", str(tmp_path / "m.json"))
     seen = []
 
     def fake_try(name, *args):
@@ -130,10 +131,11 @@ def test_ladder_clamps_to_deadline(bench, monkeypatch):
     assert seen and all(t <= 440 for _, t in seen)
 
 
-def test_negative_probe_skips_tpu_rungs(bench, monkeypatch):
+def test_negative_probe_skips_tpu_rungs(bench, monkeypatch, tmp_path):
     """A dead tunnel costs short probes, not full rung timeouts — and the
     CPU smoke rung is still reached (the r4 failure inverted: no more
     120 s cheap-shot rungs that sit below the compile time)."""
+    monkeypatch.setattr(bench, "MEASURED_PATH", str(tmp_path / "m.json"))
     seen = []
 
     def fake_try(name, platform, *args):
@@ -232,3 +234,64 @@ def test_hlo_collective_stats_parsing():
     assert s["all-gather"]["count"] == 2
     assert s["all-gather"]["bytes"] == 64 * 4 * 4 + 256 * 4
     assert s["total_count"] == 5
+
+
+def test_cpu_fallback_promotes_midround_tpu_headline(bench, monkeypatch,
+                                                     tmp_path):
+    """When the live run lands on the CPU smoke rung but the round banked a
+    TPU headline in MEASURED, the final JSON promotes it with provenance —
+    a dead tunnel at round end cannot zero the primary metric (r4 gap)."""
+    monkeypatch.setattr(bench, "MEASURED_PATH", str(tmp_path / "m.json"))
+    bench._record_measured("tpu_1024_noremat", {
+        "img_per_sec": 4.15, "mfu": 0.107, "platform": "tpu",
+        "device_kind": "TPU v5 lite", "timing_mode": "scan6_chain",
+        "rung_config": {"image_size": 1024},
+    })
+
+    def fake_try(name, platform, *args):
+        if platform == "cpu":
+            return {"value": 0.1, "platform": "cpu", "metric": "m",
+                    "unit": "u", "vs_baseline": None}, None
+        return None, f"{name}: fail"
+
+    monkeypatch.setattr(bench, "_try_rung", fake_try)
+    monkeypatch.setattr(bench, "_tpu_preflight", lambda *a, **k: False)
+    monkeypatch.setattr(bench.sys, "argv", ["bench.py"])
+    import contextlib
+    import io
+    import json
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        assert bench.main() == 0
+    out = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert out["platform"] == "tpu"
+    assert out["value"] == 4.15
+    assert out["vs_baseline"] == round(4.15 / bench.BASELINE_CLUSTER, 4)
+    assert "midround_measured" in out["headline_source"]
+    assert out["live_fallback"]["platform"] == "cpu"
+
+
+def test_all_rungs_failed_still_promotes_banked_headline(bench, monkeypatch,
+                                                         tmp_path):
+    """Even a fully-failed ladder (no CPU smoke either) folds and promotes
+    the banked TPU evidence instead of printing value 0."""
+    monkeypatch.setattr(bench, "MEASURED_PATH", str(tmp_path / "m.json"))
+    bench._record_measured("tpu_1024_noremat", {
+        "img_per_sec": 4.15, "mfu": 0.107, "platform": "tpu",
+        "rung_config": {"image_size": 1024},
+    })
+    monkeypatch.setattr(bench, "_try_rung",
+                        lambda name, *a: (None, f"{name}: fail"))
+    monkeypatch.setattr(bench, "_tpu_preflight", lambda *a, **k: False)
+    monkeypatch.setattr(bench.sys, "argv", ["bench.py"])
+    import contextlib
+    import io
+    import json
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        assert bench.main() == 0
+    out = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert out["value"] == 4.15 and out["platform"] == "tpu"
+    assert out["live_fallback"].get("error")
