@@ -208,7 +208,9 @@ _PACK_MIN_ELEMS = 1 << 24  # 16.7M elements = 32 MB bf16 per saved boundary
 
 
 def _pack_meta(shape) -> Optional[Tuple[int, int]]:
-    if len(shape) != 4:
+    import os
+
+    if os.environ.get("MPI4DL_NO_PACK") == "1" or len(shape) != 4:
         return None
     n, h, w, c = shape
     if c == 128 or (w * c) % 128 or h * w * c < _PACK_MIN_ELEMS:
