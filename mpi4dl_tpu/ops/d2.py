@@ -52,6 +52,10 @@ def layer_d2_geometry(layer) -> Optional[Tuple[int, int, int, int]]:
         return (ph, pw, sh, sw)
     if isinstance(layer, (BatchNorm, ReLU, Identity, Softmax)):
         return (0, 0, 1, 1)
+    if getattr(layer, "_d2_identity", False):
+        # Wrapper layers that consume no margin (e.g. the exact-stats
+        # striped run's fixed-statistics BN, ops/hstripe_conv.py).
+        return (0, 0, 1, 1)
     return None
 
 

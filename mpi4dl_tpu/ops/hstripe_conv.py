@@ -205,12 +205,54 @@ def _warn_engaged(pixels: int) -> None:
     if _hstripe_run_mode() == "1" or _RUN_WARNED:
         return
     _RUN_WARNED = True
-    _log.warning(
-        "H-striped block execution engaged for %s-pixel input (train-mode "
-        "BN uses per-stripe statistics; conv borders are pad-once zeros — "
-        "the halo-D2 semantics).  Set MPI4DL_HSTRIPE_RUN=0 to disable, "
-        "=1 to silence this.", pixels,
+    bn_note = (
+        "train-mode BN uses GLOBAL batch statistics (MPI4DL_HSTRIPE_EXACT)"
+        if _hstripe_exact_stats()
+        else "train-mode BN uses per-stripe statistics"
     )
+    _log.warning(
+        "H-striped block execution engaged for %s-pixel input (%s; conv "
+        "borders are pad-once zeros — the halo-D2 semantics).  Set "
+        "MPI4DL_HSTRIPE_RUN=0 to disable, =1 to silence this.",
+        pixels, bn_note,
+    )
+
+
+class _FixedStatsBN:
+    """BatchNorm with externally fixed batch statistics — the building
+    block of the exact-stats striped run: every stripe normalizes with the
+    same GLOBAL (mean, var), so striped train-mode output equals the
+    unstriped pad-once run exactly."""
+
+    _d2_identity = True  # consumes no margin (layer_d2_geometry)
+
+    def __init__(self, bn, mean, var, cnt):
+        self.bn, self.mean, self.var, self.cnt = bn, mean, var, cnt
+
+    def apply(self, params, x, ctx):
+        return self.bn.normalize_with_stats(
+            params, x, self.mean, self.var, self.cnt, ctx
+        )
+
+
+def _margin_at(layers, upto: int, m: int) -> int:
+    """Remaining H margin at the input of layers[upto] (stride-1 run)."""
+    from mpi4dl_tpu.ops.d2 import layer_d2_geometry
+
+    for layer in layers[:upto]:
+        m -= layer_d2_geometry(layer)[0]
+    return m
+
+
+def _hstripe_exact_stats() -> bool:
+    """MPI4DL_HSTRIPE_EXACT=1: train-mode BN inside a striped run uses
+    GLOBAL batch statistics, computed by a cascade of stripewise stat
+    passes (one per BN: run the prefix with earlier BNs fixed, reduce the
+    BN's input over the true rows).  Costs ~one extra prefix-forward per
+    BN; buys bit-parity with the unstriped pad-once run (the default
+    per-stripe statistics are the reference's own high-res semantics but
+    a documented deviation — advisor r4)."""
+    return os.environ.get("MPI4DL_HSTRIPE_EXACT") == "1"
 
 
 def hstripe_layer_run(layers, params_seq, x, ctx):
@@ -259,6 +301,58 @@ def hstripe_layer_run(layers, params_seq, x, ctx):
     xp = jnp.pad(x, ((0, 0), (m, m), (0, 0), (0, 0)))
     xf = xp.reshape(n, h + 2 * m, w * c)
 
+    # Exact-stats mode: fix every train-mode BN's batch statistics to the
+    # GLOBAL values before the output pass, via one stripewise stat pass
+    # per BN (prefix run with earlier BNs already fixed; the BN's input
+    # reduced over the true rows of each stripe).  Striped output then
+    # equals the unstriped pad-once run bit-for-bit (modulo reassociation).
+    eff_layers = list(layers)
+    has_lane_pad = any(
+        getattr(l, "lane_pad", 0) or getattr(l, "lane_pad_in", 0)
+        or getattr(l, "lane_pad_out", 0)
+        for l in layers
+    )
+    # lane-padded runs keep per-stripe statistics: normalize_with_stats
+    # does not support lane_pad and the padded width would mis-shape the
+    # collected stats (unreachable via the shipped models, which never
+    # combine lane_pad with hstripe shapes — defensive fallback).
+    if _hstripe_exact_stats() and ctx.train and not has_lane_pad:
+        from mpi4dl_tpu.layers import BatchNorm as _BN
+
+        acc_dt = jnp.promote_types(jnp.float32, x.dtype)
+        sctx_nostat = dataclasses.replace(sctx, bn_sink=None)
+        for j, layer in enumerate(layers):
+            if not isinstance(layer, _BN):
+                continue
+            if j == 0:
+                s = jnp.sum(x, axis=(0, 1, 2), dtype=acc_dt)
+                ss = jnp.sum(jnp.square(x.astype(acc_dt)), axis=(0, 1, 2))
+            else:
+                mh_j = _margin_at(eff_layers, j, m)
+
+                def stat_piece(i, _j=j, _mh=mh_j):
+                    xs = lax.dynamic_slice_in_dim(
+                        xf, i * sh, sh + 2 * m, axis=1
+                    )
+                    xs = xs.reshape(n, sh + 2 * m, w, c)
+                    y, mh_out, _ = apply_layers_premargin(
+                        eff_layers[:_j], params_seq[:_j], xs,
+                        sctx_nostat, m, 0,
+                    )
+                    assert mh_out == _mh, (mh_out, _mh)
+                    t = y[:, _mh:_mh + sh]
+                    return (
+                        jnp.sum(t, axis=(0, 1, 2), dtype=acc_dt),
+                        jnp.sum(jnp.square(t.astype(acc_dt)), axis=(0, 1, 2)),
+                    )
+
+                sA, ssA = lax.map(stat_piece, jnp.arange(stripes))
+                s, ss = jnp.sum(sA, axis=0), jnp.sum(ssA, axis=0)
+            cnt = jnp.asarray(n * h * w, acc_dt)
+            mean = s / cnt
+            var = jnp.maximum(ss / cnt - mean * mean, 0.0)
+            eff_layers[j] = _FixedStatsBN(layer, mean, var, cnt)
+
     def piece(i):
         xs = lax.dynamic_slice_in_dim(xf, i * sh, sh + 2 * m, axis=1)
         xs = xs.reshape(n, sh + 2 * m, w, c)
@@ -267,7 +361,7 @@ def hstripe_layer_run(layers, params_seq, x, ctx):
             cc = dataclasses.replace(sctx, bn_sink=inner)
         else:
             inner, cc = None, sctx
-        y, mh, mw = apply_layers_premargin(layers, params_seq, xs, cc, m, 0)
+        y, mh, mw = apply_layers_premargin(eff_layers, params_seq, xs, cc, m, 0)
         assert mh == 0 and mw == 0, (mh, mw)
         # The reassembly below assumes every layer preserves W (SAME pads on
         # the unsharded dim) — a W-shrinking run would scramble the reshape.

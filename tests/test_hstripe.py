@@ -171,11 +171,12 @@ def test_boundary_packing_exact(monkeypatch, remat):
         )
 
 
-def _fake_sp_ctx(train=True):
+def _fake_sp_ctx(train=True, bn_sink=None):
     from mpi4dl_tpu.layer_ctx import ApplyCtx, SpatialCtx
 
-    sp = SpatialCtx(axis_h="sph", grid_h=4, bn_cross_tile=False)
-    return ApplyCtx(train=train, spatial=sp)
+    sp = SpatialCtx(axis_h="sph", grid_h=4, bn_cross_tile=False,
+                    stat_local=True)
+    return ApplyCtx(train=train, spatial=sp, bn_sink=bn_sink)
 
 
 def test_hstripe_layer_run_matches_pad_once(monkeypatch):
@@ -332,3 +333,57 @@ def test_resnet_branch_remat_ops_exact(monkeypatch):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6
         )
+
+
+def test_hstripe_exact_stats_matches_pad_once_train(monkeypatch):
+    """MPI4DL_HSTRIPE_EXACT=1: striped TRAIN-mode run with BatchNorms ==
+    the pad-once emulation with GLOBAL batch statistics — values, grads,
+    and running-stat deposits (the per-stripe-stats deviation removed)."""
+    from mpi4dl_tpu.layer_ctx import ApplyCtx
+    from mpi4dl_tpu.layers import BatchNorm, Conv2d, ReLU
+    from mpi4dl_tpu.ops.d2 import accumulated_halo, apply_layers_premargin
+
+    monkeypatch.setattr(hc, "_RUN_STRIPE_BUDGET", 4000)
+    monkeypatch.setenv("MPI4DL_HSTRIPE_EXACT", "1")
+    layers = [BatchNorm(4), ReLU(), Conv2d(4, 8, 3, bias=False),
+              BatchNorm(8), ReLU(), Conv2d(8, 8, 3, bias=False)]
+    params = []
+    shape = (2, 16, 12, 4)
+    for i, l in enumerate(layers):
+        pp, shape = l.init(jax.random.fold_in(jax.random.key(0), i), shape)
+        params.append(pp)
+    x = jax.random.normal(jax.random.key(1), (2, 16, 12, 4))
+    m = accumulated_halo(layers)[0]
+
+    def striped(x, sink=None):
+        ctx = ApplyCtx(train=True, bn_sink=sink)
+        y = hc.hstripe_layer_run(layers, params, x, ctx)
+        assert y is not None
+        return y
+
+    def emulated(x, sink=None):
+        xp = jnp.pad(x, ((0, 0), (m, m), (0, 0), (0, 0)))
+        y, mh, mw = apply_layers_premargin(
+            layers, params, xp, _fake_sp_ctx(train=True, bn_sink=sink), m, 0
+        )
+        assert mh == 0 and mw == 0
+        return y
+
+    sink_s, sink_e = {}, {}
+    y_s, y_e = striped(x, sink_s), emulated(x, sink_e)
+    np.testing.assert_allclose(np.asarray(y_s), np.asarray(y_e), atol=1e-5)
+    # Running-stat deposits agree (same GLOBAL statistics).
+    assert len(sink_s) == len(sink_e) > 0
+    for k in sink_e:
+        np.testing.assert_allclose(
+            np.asarray(sink_s[k]), np.asarray(sink_e[k]), atol=1e-5
+        )
+    g_s = jax.grad(lambda x: jnp.sum(striped(x) ** 2))(x)
+    g_e = jax.grad(lambda x: jnp.sum(emulated(x) ** 2))(x)
+    np.testing.assert_allclose(np.asarray(g_s), np.asarray(g_e), atol=1e-4)
+
+    # Default (per-stripe) mode really deviates on this fixture — the
+    # exact mode is measurably doing something.
+    monkeypatch.delenv("MPI4DL_HSTRIPE_EXACT")
+    y_d = striped(x)
+    assert not np.allclose(np.asarray(y_d), np.asarray(y_e), atol=1e-5)
