@@ -43,6 +43,7 @@ import time
 BASELINE_CLUSTER = 2.1   # reference: AmoebaNet-D 1024² bs1, SP square + D2, 5 GPUs
 BASELINE_DEVICES = 5
 BASELINE_2048 = 2.85     # reference: AmoebaNet-D 2048² bs1, SP vertical + D2, 5 GPUs
+BASELINE_2048_BS2 = 5.0  # reference: AmoebaNet-D 2048² bs2 — its best chart point
 BASELINE_1024_BS2 = 2.95  # reference: AmoebaNet-D 1024² bs2, SP square + D2, 5 GPUs
 BASELINE_RESNET_1024 = 2.55  # reference: ResNet-110-v2 1024² bs1, SP best, 5 GPUs
 BASELINE_RESNET_2048 = 0.99  # reference: ResNet-110-v2 2048² bs1, SP, 5 GPUs
@@ -605,6 +606,31 @@ def _tpu_preflight(timeout_s: int = 240) -> bool:
 _OOM_RE = r"Ran out of memory|RESOURCE_EXHAUSTED|Out of memory"
 
 
+def _remat_ladder(name, px, tries, iters, batch, timeout_cap, health):
+    """OOM remat/scan ladder shared by the batch-scaling rungs: walk
+    ``tries`` = [(remat, scan), ...] until one fits; only OOM justifies
+    the next attempt (any other failure invalidates TPU health and stops).
+    Returns (result_or_None, joined_errors)."""
+    r, errs = None, []
+    for rm, t_scan in tries:
+        if _time_left() < 300:
+            errs.append(f"{rm}/scan{t_scan}: skipped (bench deadline reached)")
+            break
+        r, e = _try_rung(
+            name, "tpu", px, 18, 416, 1, iters,
+            min(timeout_cap, max(300, _time_left() - 300)), False, rm,
+            batch, t_scan,
+        )
+        if r is not None:
+            health.note_success()
+            break
+        errs.append(f"{rm}/scan{t_scan}: {e}")
+        _note_health(health, r, e)
+        if not _re.search(_OOM_RE, e or ""):
+            break
+    return r, "; ".join(errs)
+
+
 def _note_health(health, result, err) -> None:
     """Update the health cache from a rung outcome.  An OOM death proves
     live TPU contact just as a parsed result does — memory-frontier rungs
@@ -788,6 +814,18 @@ def main() -> int:
             _note_health(health, r2048, err)
             headline["rungs"]["2048"] = _rung_summary(
                 r2048, err, BASELINE_2048, "vs_baseline_cluster_2048")
+        # 2048² bs2 — the reference's single best chart point (≈5.0 img/s
+        # across 5 GPUs, AmeobaNet_img_size_2048.png); never measured here
+        # before r5.  Honest attempt: cell remat, then fine on OOM.
+        if tpu_gate("2048_bs2"):
+            print("[bench] 2048px bs2 rung", file=sys.stderr)
+            r_b2, b2_errs = _remat_ladder(
+                "tpu_2048_bs2", 2048, [("cell", 1), ("fine", 1)], 4, 2,
+                1500, health,
+            )
+            headline["rungs"]["2048_bs2"] = _rung_summary(
+                r_b2, b2_errs, BASELINE_2048_BS2,
+                "vs_baseline_cluster_2048_bs2")
         # Batch-scaling rungs at the flagship resolution (VERDICT r3 task 2:
         # the reference scales positively bs1→bs2; bs4/bs8 chart the curve).
         # no-remat first, remat fallback on OOM.
@@ -797,41 +835,25 @@ def main() -> int:
             if not tpu_gate(bname):
                 continue
             print(f"[bench] 1024px bs{bs} rung", file=sys.stderr)
-            r_b, b_errs = None, []
             # OOM ladder: prefer no-remat (backward reads stored
             # activations, ~21% faster); before surrendering to cell
             # remat, drop the scan wrapper — its loop-carry
             # double-buffering costs real GBs (measured ~3.7 GB at 2048²),
             # which is exactly what pushed r5's bs4 rung into the cell
-            # fallback (3.75 img/s vs bs2's 4.49 at none).
+            # fallback (3.75 img/s vs bs2's 4.49 at none).  iters is the
+            # RUNG's step count regardless of which scan wins (it only
+            # needs to be a multiple of the active scan, and rung_scan
+            # is): a scan-drop retry must not shrink the sample.
             tries = [("none", rung_scan), ("none", 1),
                      ("cell", rung_scan), ("cell", 1)]
             if rung_scan == 1:
                 tries = [("none", 1), ("cell", 1)]
-            # iters is the RUNG's step count regardless of which scan wins
-            # (it only needs to be a multiple of the active scan, and
-            # rung_scan is): a scan-drop retry must not shrink the sample.
-            iters_b = 2 * bs * rung_scan
-            for rm, t_scan in tries:
-                if _time_left() < 300:
-                    b_errs.append(f"{rm}: skipped (bench deadline reached)")
-                    break
-                r_b, e = _try_rung(
-                    f"tpu_{bname}", "tpu", 1024, 18, 416, 1, iters_b,
-                    min(1200, max(300, _time_left() - 300)), False, rm, bs,
-                    t_scan,
-                )
-                if r_b is not None:
-                    health.note_success()
-                    break
-                b_errs.append(f"{rm}/scan{t_scan}: {e}")
-                if not _re.search(_OOM_RE, e or ""):
-                    # Only OOM justifies the remat retry; a hang/backend
-                    # failure would just burn the probes' budget.
-                    health.note_rung_failure()
-                    break
+            r_b, b_errs = _remat_ladder(
+                f"tpu_{bname}", 1024, tries, 2 * bs * rung_scan, bs,
+                1200, health,
+            )
             headline["rungs"][bname] = _rung_summary(
-                r_b, "; ".join(b_errs),
+                r_b, b_errs,
                 BASELINE_1024_BS2 if bs == 2 else None,
                 "vs_baseline_cluster_1024_bs2" if bs == 2 else "vs_baseline",
             )

@@ -196,18 +196,20 @@ def hstripe_run_eligible(layers, x_shape, ctx) -> bool:
     return True
 
 
-def _warn_engaged(pixels: int) -> None:
+def _warn_engaged(pixels: int, exact_active: bool) -> None:
     """One-time engagement warning — emitted from hstripe_layer_run only
     once striping is actually committed (an eligible run can still fall
     back when no reasonable stripe divisor exists, and warning there would
-    both mislead and consume the single warning slot — advisor r5)."""
+    both mislead and consume the single warning slot — advisor r5).
+    ``exact_active`` is the REAL statistics mode of this run (the env flag
+    alone can be overridden by the lane_pad fallback)."""
     global _RUN_WARNED
     if _hstripe_run_mode() == "1" or _RUN_WARNED:
         return
     _RUN_WARNED = True
     bn_note = (
         "train-mode BN uses GLOBAL batch statistics (MPI4DL_HSTRIPE_EXACT)"
-        if _hstripe_exact_stats()
+        if exact_active
         else "train-mode BN uses per-stripe statistics"
     )
     _log.warning(
@@ -290,8 +292,6 @@ def hstripe_layer_run(layers, params_seq, x, ctx):
         # the per-stripe BN statistics), so fall back to the plain path
         # rather than degenerate into per-row scan steps (advisor r4).
         return None  # caller takes its normal path
-    _warn_engaged(h * w)
-
     sp_fake = SpatialCtx(
         axis_h="sph", grid_h=stripes, bn_cross_tile=False, stat_local=True
     )
@@ -316,7 +316,9 @@ def hstripe_layer_run(layers, params_seq, x, ctx):
     # does not support lane_pad and the padded width would mis-shape the
     # collected stats (unreachable via the shipped models, which never
     # combine lane_pad with hstripe shapes — defensive fallback).
-    if _hstripe_exact_stats() and ctx.train and not has_lane_pad:
+    exact_active = _hstripe_exact_stats() and ctx.train and not has_lane_pad
+    _warn_engaged(h * w, exact_active)
+    if exact_active:
         from mpi4dl_tpu.layers import BatchNorm as _BN
 
         acc_dt = jnp.promote_types(jnp.float32, x.dtype)
