@@ -278,25 +278,46 @@ class AmoebaCell(Cell):
             s1, s2 = x
         else:
             s1 = s2 = x
+        # One DAG walk; states are (value, pack_meta) pairs.  Fine remat
+        # (ctx.remat_ops): each reduce/op is its own checkpoint region, so
+        # the backward holds one op's internals at a time instead of the
+        # whole cell DAG's (max-trainable-resolution lever) — and the DAG
+        # states BETWEEN op checkpoints are stored lane-packed
+        # ([N,H,W*C/128,128], cells.py): they are the live set of the
+        # cell's backward, and at 2048-res they were the 4096² OOM
+        # top-list ([1,2048,2048,208] ~1.6 GB x4+, PERF_NOTES r4).
+        # Pack/unpack lives INSIDE each checkpoint (in_meta), so only the
+        # packed form is ever saved; h1+h2 adds packed forms directly
+        # (packing is a reshape — elementwise-safe).  Plain path: meta is
+        # always None and app is a direct call.
+        from mpi4dl_tpu.cells import _unpack_one
+
         if ctx.remat_ops:
-            # Fine remat: each reduce/op is its own checkpoint region, so
-            # the backward holds one op's internals at a time instead of
-            # the whole cell DAG's (max-trainable-resolution lever).
-            app = lambda l, p, s: checkpointed_apply(l.apply, p, s, ctx)
+            def app(l, p, state):
+                s, meta = state
+                return checkpointed_apply(
+                    l.apply, p, s, ctx, in_meta=meta, pack=True
+                )
         else:
-            app = lambda l, p, s: l.apply(p, s, ctx)
+            def app(l, p, state):
+                return l.apply(p, state[0], ctx), None
+
         skip = s1
-        s1 = app(self.reduce1, params["reduce1"], s1)
-        s2 = app(self.reduce2, params["reduce2"], s2)
-        states = [s1, s2]
+        states = [
+            app(self.reduce1, params["reduce1"], (s1, None)),
+            app(self.reduce2, params["reduce2"], (s2, None)),
+        ]
         for j in range(0, len(self.ops), 2):
-            h1 = app(self.ops[j], params["ops"][j], states[self.indices[j]])
-            h2 = app(
+            y1, m1 = app(self.ops[j], params["ops"][j], states[self.indices[j]])
+            y2, m2 = app(
                 self.ops[j + 1], params["ops"][j + 1],
                 states[self.indices[j + 1]],
             )
-            states.append(h1 + h2)
-        out = jnp.concatenate([states[i] for i in self.concat], axis=-1)
+            assert m1 == m2, (m1, m2)
+            states.append((y1 + y2, m1))
+        out = jnp.concatenate(
+            [_unpack_one(*states[i]) for i in self.concat], axis=-1
+        )
         return (out, skip)
 
     # ---- cell-level D2 (the reference's Cell_D2, amoebanet_d2.py:569-728) --

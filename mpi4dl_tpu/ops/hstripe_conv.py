@@ -196,15 +196,18 @@ def hstripe_run_eligible(layers, x_shape, ctx) -> bool:
     return True
 
 
-def _warn_engaged(pixels: int, exact_active: bool) -> None:
+def _warn_engaged(pixels: int, exact_active: bool, train: bool) -> None:
     """One-time engagement warning — emitted from hstripe_layer_run only
     once striping is actually committed (an eligible run can still fall
     back when no reasonable stripe divisor exists, and warning there would
     both mislead and consume the single warning slot — advisor r5).
     ``exact_active`` is the REAL statistics mode of this run (the env flag
-    alone can be overridden by the lane_pad fallback)."""
+    alone can be overridden by the lane_pad fallback).  Eval-mode runs
+    neither warn nor latch: they have no statistics deviation, and an
+    eval-first job must not consume the slot with a message describing
+    semantics its later TRAIN runs will not have."""
     global _RUN_WARNED
-    if _hstripe_run_mode() == "1" or _RUN_WARNED:
+    if not train or _hstripe_run_mode() == "1" or _RUN_WARNED:
         return
     _RUN_WARNED = True
     bn_note = (
@@ -317,7 +320,7 @@ def hstripe_layer_run(layers, params_seq, x, ctx):
     # collected stats (unreachable via the shipped models, which never
     # combine lane_pad with hstripe shapes — defensive fallback).
     exact_active = _hstripe_exact_stats() and ctx.train and not has_lane_pad
-    _warn_engaged(h * w, exact_active)
+    _warn_engaged(h * w, exact_active, ctx.train)
     if exact_active:
         from mpi4dl_tpu.layers import BatchNorm as _BN
 

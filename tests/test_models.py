@@ -138,3 +138,35 @@ def test_lane_pad_function_preserving(monkeypatch):
     np.testing.assert_allclose(
         float(met0["loss"]), float(met1["loss"]), rtol=2e-3
     )
+
+
+def test_amoebanet_fine_remat_packed_states_exact(monkeypatch):
+    """remat='fine' (per-op checkpoints with lane-packed DAG states) must
+    be bit-level equivalent to the no-remat path: packing is a reshape and
+    checkpoint recompute replays identical ops."""
+    from mpi4dl_tpu import cells as C
+    from mpi4dl_tpu.train import Optimizer, TrainState, make_train_step
+
+    monkeypatch.setattr(C, "_PACK_MIN_ELEMS", 1)
+    model = amoebanetd((2, 32, 32, 3), num_classes=10, num_layers=3,
+                       num_filters=16)
+    params, _ = model.init(jax.random.key(0))
+    # Packing really engages on these DAG states (W*C = 16*16=256 | 128).
+    assert C._pack_meta((2, 16, 16, 16)) == (16, 16)
+    opt = Optimizer("sgd", lr=0.01)
+    x = jax.random.normal(jax.random.key(1), (2, 32, 32, 3))
+    y = jnp.arange(2, dtype=jnp.int32)
+    s_f = TrainState.create(params, opt)
+    s_o = TrainState.create(params, opt)
+    step_f = make_train_step(model, opt, remat="fine")
+    step_o = make_train_step(model, opt)
+    for _ in range(2):
+        s_f, m_f = step_f(s_f, x, y)
+        s_o, m_o = step_o(s_o, x, y)
+        np.testing.assert_allclose(
+            float(m_f["loss"]), float(m_o["loss"]), rtol=1e-6
+        )
+    for a, b in zip(jax.tree.leaves(s_f.params), jax.tree.leaves(s_o.params)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+        )
