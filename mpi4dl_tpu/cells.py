@@ -200,22 +200,31 @@ class CellModel:
 # [1,416,416,1664] bf16, measured ~2x its 553 MB logical size: an unpacked
 # narrow-tile layout this reshape makes impossible).  The pack/unpack
 # reshapes live INSIDE the checkpoint, so only the packed form is ever
-# stored.  Gated to large boundaries with W*C a multiple of 128 (and C not
-# already exactly 128); packs nothing otherwise — zero graph change.
+# stored.  Gated to large boundaries (and C not already exactly 128):
+# W*C a multiple of 128 takes the W-fold form [N,H,W*C/128,128]; otherwise
+# (margined SP tiles) H*W*C a multiple of 128 takes the full-flatten form
+# [N,H*W*C/128,128]; packs nothing else — zero graph change.
 # ---------------------------------------------------------------------------
 
 _PACK_MIN_ELEMS = 1 << 24  # 16.7M elements = 32 MB bf16 per saved boundary
 
 
-def _pack_meta(shape) -> Optional[Tuple[int, int]]:
+def _pack_meta(shape):
+    """(w, c) for the W-fold form [N,H,W*C/128,128], or (h, w, c) for the
+    full-flatten form [N,H*W*C/128,128] (margined SP tiles, whose halo
+    rows/cols break the per-row divisibility), or None (no packing)."""
     import os
 
     if os.environ.get("MPI4DL_NO_PACK") == "1" or len(shape) != 4:
         return None
     n, h, w, c = shape
-    if c == 128 or (w * c) % 128 or h * w * c < _PACK_MIN_ELEMS:
+    if c == 128 or h * w * c < _PACK_MIN_ELEMS:
         return None
-    return (w, c)
+    if (w * c) % 128 == 0:
+        return (w, c)
+    if (h * w * c) % 128 == 0:
+        return (h, w, c)
+    return None
 
 
 def _pack_one(x):
@@ -223,14 +232,19 @@ def _pack_one(x):
     if m is None:
         return x, None
     n, h, w, c = x.shape
-    return x.reshape(n, h, (w * c) // 128, 128), m
+    if len(m) == 2:
+        return x.reshape(n, h, (w * c) // 128, 128), m
+    return x.reshape(n, (h * w * c) // 128, 128), m
 
 
 def _unpack_one(x, m):
     if m is None:
         return x
-    w, c = m
-    n, h, _, _ = x.shape
+    n = x.shape[0]
+    if len(m) == 2:
+        w, c = m
+        return x.reshape(n, x.shape[1], w, c)
+    h, w, c = m
     return x.reshape(n, h, w, c)
 
 

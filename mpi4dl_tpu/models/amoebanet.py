@@ -24,7 +24,9 @@ from typing import Any, Callable, List, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-from mpi4dl_tpu.cells import Cell, CellModel, LayerCell, checkpointed_apply
+from mpi4dl_tpu.cells import (
+    Cell, CellModel, LayerCell, _unpack_one, checkpointed_apply,
+)
 from mpi4dl_tpu.layer_ctx import ApplyCtx
 from mpi4dl_tpu.layers import (
     BatchNorm,
@@ -290,8 +292,6 @@ class AmoebaCell(Cell):
         # packed form is ever saved; h1+h2 adds packed forms directly
         # (packing is a reshape — elementwise-safe).  Plain path: meta is
         # always None and app is a direct call.
-        from mpi4dl_tpu.cells import _unpack_one
-
         if ctx.remat_ops:
             def app(l, p, state):
                 s, meta = state

@@ -293,14 +293,19 @@ def test_pack_meta_gates():
     # Below the size gate: no packing.
     assert C._pack_meta((1, 8, 8, 16)) is None
     big = C._PACK_MIN_ELEMS
-    # Exactly 128 lanes already, or W*C not a 128-multiple: no packing.
+    # Exactly 128 lanes already: no packing.  W*C not a 128-multiple
+    # falls back to full-flatten when the TOTAL divides (r5).
     assert C._pack_meta((1, big, 1, 128)) is None
-    assert C._pack_meta((1, big, 1, 48)) is None
-    assert C._pack_meta((1, big, 3, 64)) is None
-    assert C._pack_meta((1, big, 4, 64)) == (4, 64)
+    assert C._pack_meta((1, big, 1, 48)) == (big, 1, 48)  # full-flatten
+    assert C._pack_meta((1, big, 3, 64)) == (big, 3, 64)  # full-flatten
+    assert C._pack_meta((1, big, 4, 64)) == (4, 64)       # W-fold preferred
     # New in r5 (the AmoebaNet frontier masses): C > 128 packs too.
     assert C._pack_meta((1, 416, 416, 1664)) == (416, 1664)
     assert C._pack_meta((1, 2048, 2048, 208)) == (2048, 208)
+    # Margined SP tiles (halo cols break per-row divisibility) take the
+    # full-flatten form when the total divides — and pass otherwise.
+    assert C._pack_meta((1, 2056, 2054, 208)) == (2056, 2054, 208)
+    assert C._pack_meta((1, 2054, 2054, 208)) is None
 
 
 def test_resnet_branch_remat_ops_exact(monkeypatch):
