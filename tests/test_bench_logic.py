@@ -12,11 +12,14 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.fixture()
-def bench(monkeypatch):
+def bench(monkeypatch, tmp_path):
     monkeypatch.syspath_prepend(_REPO)
     mod = importlib.import_module("bench")
     # Freeze the wall clock budget: tests must not depend on elapsed time.
     monkeypatch.setattr(mod, "_time_left", lambda: 10_000.0)
+    # Never let a test write into the repo's real hardware-evidence file
+    # (fits() banks probe successes via _record_measured).
+    monkeypatch.setattr(mod, "MEASURED_PATH", str(tmp_path / "measured.json"))
     return mod
 
 
@@ -295,3 +298,48 @@ def test_all_rungs_failed_still_promotes_banked_headline(bench, monkeypatch,
     out = json.loads(buf.getvalue().strip().splitlines()[-1])
     assert out["value"] == 4.15 and out["platform"] == "tpu"
     assert out["live_fallback"].get("error")
+
+
+def test_probe_seeding_from_banked_evidence(bench, monkeypatch):
+    """A mid-round probe success (probe_<px> in MEASURED) seeds the final
+    run's max-resolution ladder so proven compiles are never re-paid."""
+    bench._record_measured("probe_3072", {
+        "ok": True, "first_step_s": 120.0, "platform": "tpu",
+        "rung_config": {"image_size": 3072},
+    })
+
+    def fake_try(name, platform, *args):
+        return {"value": 4.0, "platform": "tpu", "metric": "m", "unit": "u",
+                "vs_baseline": 1.9, "mfu": 0.1}, None
+
+    seen = {}
+
+    def fake_probe(start, known_fit, gate=None, note_ok=None):
+        seen.update(start=start, known_fit=known_fit)
+        return known_fit, {}
+
+    monkeypatch.setattr(bench, "_try_rung", fake_try)
+    monkeypatch.setattr(bench, "_max_trainable_px", fake_probe)
+    monkeypatch.setattr(bench, "_tpu_preflight", lambda *a, **k: True)
+    monkeypatch.setattr(bench.sys, "argv", ["bench.py"])
+    import contextlib
+    import io
+    import json
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        assert bench.main() == 0
+    out = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert seen["known_fit"] == 3072
+    assert seen["start"] == 2048
+    assert out["max_trainable_px"] == 3072
+
+
+def test_max_trainable_px_seeded_cap_still_probed(bench, monkeypatch):
+    """A non-power-of-2 seed (3072) must not overshoot the cap unprobed:
+    6144 fits -> the ladder probes 8192 itself and can report the cap."""
+    runner = _fake_runner(fits_px=10_000)
+    monkeypatch.setattr(bench, "_run_sub", runner)
+    best, attempts = bench._max_trainable_px(start=2048, known_fit=3072)
+    assert best == 8192
+    assert 8192 in runner.calls
