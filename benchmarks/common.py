@@ -254,12 +254,11 @@ def _ensure_devices(need: int) -> None:
             return
     except Exception:
         return
-    try:
-        # Inert unless the CPU platform actually gets selected (explicitly or
-        # by auto-fallback), so a live GPU/TPU is never hijacked.
-        jax.config.update("jax_num_cpu_devices", max(need, 8))
-    except Exception as e:  # noqa: BLE001 — best effort; build_mesh reports
-        print(f"note: could not self-provision CPU devices: {e}")
+    # Inert unless the CPU platform actually gets selected (explicitly or
+    # by auto-fallback), so a live GPU/TPU is never hijacked.
+    from mpi4dl_tpu.compat import ensure_host_device_count
+
+    ensure_host_device_count(max(need, 8))
 
 
 def _batches(dataset, batch_size: int, steps: int, num_workers: int):

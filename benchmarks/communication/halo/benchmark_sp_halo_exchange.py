@@ -61,7 +61,8 @@ def main(argv=None) -> int:
     import jax
     import jax.numpy as jnp
     import numpy as np
-    from jax import lax, shard_map
+    from jax import lax
+    from mpi4dl_tpu.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from mpi4dl_tpu.layer_ctx import spatial_ctx_for

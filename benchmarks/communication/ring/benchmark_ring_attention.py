@@ -108,7 +108,7 @@ def main(argv=None) -> int:
         ))
 
     if args.ring_devices > 1:
-        from jax import shard_map
+        from mpi4dl_tpu.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         from mpi4dl_tpu.mesh import MeshSpec, build_mesh

@@ -56,12 +56,15 @@ def main(argv=None) -> int:
     n_dev = args.tiles * args.tiles * args.stages
     import jax
 
+    from benchmarks.common import _ensure_devices
+
     try:
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", n_dev)
-    except Exception as e:
-        if len(jax.devices()) < n_dev:
-            raise SystemExit(f"needs {n_dev} devices (got {len(jax.devices())})") from e
+    except Exception:  # noqa: BLE001 — backend already up; checked below
+        pass
+    _ensure_devices(n_dev)
+    if len(jax.devices()) < n_dev:
+        raise SystemExit(f"needs {n_dev} devices (got {len(jax.devices())})")
 
     import jax.numpy as jnp
 

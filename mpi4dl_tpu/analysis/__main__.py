@@ -1,0 +1,124 @@
+"""CLI: ``python -m mpi4dl_tpu.analysis [--json] [--baseline F] [paths...]``.
+
+With no paths, scans the repository tree the package sits in: the package
+itself plus ``tests/``, ``benchmarks/``, ``bench.py`` and
+``__graft_entry__.py`` (the env-hatch dead-flag check needs the whole tree —
+several hatches are read only by the harness).  Exit status: 0 when no
+violations remain after baseline filtering, 1 otherwise, 2 on usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+from mpi4dl_tpu.analysis import (
+    RULE_TABLE,
+    apply_baseline,
+    build_project,
+    load_baseline,
+    run_rules,
+)
+
+
+def default_paths(root: str) -> List[str]:
+    cand = ["mpi4dl_tpu", "tests", "benchmarks", "bench.py", "__graft_entry__.py"]
+    return [os.path.join(root, c) for c in cand if os.path.exists(os.path.join(root, c))]
+
+
+def repo_root() -> str:
+    # the directory that holds the mpi4dl_tpu package
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(pkg_dir)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m mpi4dl_tpu.analysis",
+        description="Shard-safety static analyzer (see docs/analysis.md).",
+    )
+    ap.add_argument("paths", nargs="*", help="files/dirs to scan (default: repo tree)")
+    ap.add_argument("--json", action="store_true", help="machine-readable output")
+    ap.add_argument("--baseline", metavar="F", default=None,
+                    help="JSON list of accepted violations to filter out")
+    ap.add_argument("--rule", action="append", default=None, metavar="NAME",
+                    help="run only the named rule(s)")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--hatch-docs", action="store_true",
+                    help="print the README env-hatch table from config.HATCHES")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in RULE_TABLE:
+            print(f"{r.name}: {r.description}")
+        return 0
+    if args.hatch_docs:
+        from mpi4dl_tpu.config import hatches_markdown
+
+        print(hatches_markdown())
+        return 0
+
+    root = repo_root()
+    paths = args.paths or default_paths(root)
+    if not paths:
+        print("analysis: nothing to scan", file=sys.stderr)
+        return 2
+
+    rules = RULE_TABLE
+    if args.rule:
+        by_name = {r.name: r for r in RULE_TABLE}
+        unknown = [n for n in args.rule if n not in by_name]
+        if unknown:
+            print(f"analysis: unknown rule(s) {unknown}; have "
+                  f"{sorted(by_name)}", file=sys.stderr)
+            return 2
+        rules = [by_name[n] for n in args.rule]
+
+    project = build_project(paths, root=root)
+    violations = run_rules(project, rules)
+
+    stale: List[dict] = []
+    if args.baseline:
+        baseline = load_baseline(args.baseline)
+        violations, stale = apply_baseline(violations, baseline)
+
+    if args.json:
+        print(json.dumps(
+            {
+                "violations": [
+                    {
+                        "rule": v.rule,
+                        "path": v.path,
+                        "line": v.line,
+                        "message": v.message,
+                    }
+                    for v in violations
+                ],
+                "stale_baseline": stale,
+            },
+            indent=2,
+        ))
+    else:
+        for v in violations:
+            print(v.render())
+        for e in stale:
+            print(
+                f"note: stale baseline entry (no longer fires): "
+                f"{e.get('path')}: [{e.get('rule')}] {e.get('message')}",
+                file=sys.stderr,
+            )
+        n_files = len(project.files)
+        print(
+            f"analysis: {len(violations)} violation(s) in {n_files} file(s) "
+            f"[axes={','.join(project.axes) or '?'}; "
+            f"hatches={len(project.hatches)}]",
+            file=sys.stderr,
+        )
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

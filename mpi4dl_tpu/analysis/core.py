@@ -1,0 +1,386 @@
+"""Framework for the shard-safety analyzer.
+
+Pure-``ast`` static analysis — no dependency beyond the standard library, and
+no imports of the analyzed code (so it runs in seconds on any CPU host, which
+is the whole point: the invariants it proves — mesh-axis names, ``ppermute``
+bijections, dtype policy, env-hatch hygiene, retrace hazards — otherwise
+surface only when a TPU tunnel window opens, which round 5 showed can be 8+
+hours away).
+
+Vocabulary:
+
+- A :class:`SourceFile` is one parsed module: its AST, per-line pragma
+  allowlist, and an import-alias table (so rules can resolve ``np``/``jnp``/
+  ``P`` to their canonical modules without executing anything).
+- A :class:`Project` is the set of scanned files plus the extracted ground
+  truth: the mesh-axis vocabulary from ``mesh.py`` and the env-hatch registry
+  from ``config.py`` — both parsed statically, falling back to the installed
+  package sources when the scanned paths don't include them (e.g. when
+  linting test fixtures).
+- A :class:`Rule` contributes :class:`Violation` objects; the runner applies
+  pragma suppression and the checked-in baseline, then reports.
+
+Pragma syntax (suppresses on its own line, or the whole function when placed
+on the ``def`` line)::
+
+    x = float(eps)  # analysis: ok(tracer-leak)
+    def helper():   # analysis: ok(tracer-leak, dtype-policy)
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+_PRAGMA_RE = re.compile(r"#\s*analysis:\s*ok\(([^)]*)\)")
+_HATCH_NAME_RE = re.compile(r"^_?MPI4DL_[A-Z0-9_]+$")
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str  # scan-root-relative, forward slashes
+    line: int
+    message: str
+
+    @property
+    def baseline_key(self) -> Tuple[str, str, str]:
+        # Line numbers drift with unrelated edits; baseline entries match on
+        # (rule, path, message) so a justified exception survives refactors.
+        return (self.rule, self.path, self.message)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class SourceFile:
+    """One parsed python module with pragma and import-alias tables."""
+
+    def __init__(self, path: str, rel: str, text: str):
+        self.path = path
+        self.rel = rel.replace(os.sep, "/")
+        self.text = text
+        self.tree = ast.parse(text, filename=path)
+        self.pragmas = self._collect_pragmas(text)
+        self.aliases = self._collect_aliases(self.tree)
+        self.func_spans = self._collect_func_spans(self.tree)
+
+    # -- pragmas -----------------------------------------------------------
+    @staticmethod
+    def _collect_pragmas(text: str) -> Dict[int, Set[str]]:
+        out: Dict[int, Set[str]] = {}
+        try:
+            toks = tokenize.generate_tokens(io.StringIO(text).readline)
+            for tok in toks:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _PRAGMA_RE.search(tok.string)
+                if m:
+                    rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+                    out.setdefault(tok.start[0], set()).update(rules or {"*"})
+        except tokenize.TokenError:
+            pass
+        return out
+
+    @staticmethod
+    def _collect_func_spans(tree: ast.AST) -> List[Tuple[int, int, int]]:
+        """(def_line, body_start, body_end) for every function."""
+        spans = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                end = getattr(node, "end_lineno", node.lineno)
+                spans.append((node.lineno, node.lineno, end))
+        return spans
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        def hit(rules: Set[str]) -> bool:
+            return "*" in rules or rule in rules
+
+        if line in self.pragmas and hit(self.pragmas[line]):
+            return True
+        # a pragma on a def line covers the whole function body
+        for def_line, start, end in self.func_spans:
+            if start <= line <= end and def_line in self.pragmas and hit(
+                self.pragmas[def_line]
+            ):
+                return True
+        return False
+
+    # -- import aliases ----------------------------------------------------
+    @staticmethod
+    def _collect_aliases(tree: ast.AST) -> Dict[str, str]:
+        """Map local name -> dotted canonical origin.
+
+        ``import numpy as np`` -> {'np': 'numpy'};
+        ``from jax.sharding import PartitionSpec as P`` ->
+        {'P': 'jax.sharding.PartitionSpec'};
+        ``from jax import lax`` -> {'lax': 'jax.lax'}.
+        Collected from every scope (local imports are common here).
+        """
+        out: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    out[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    out[a.asname or a.name] = f"{node.module}.{a.name}"
+        return out
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Dotted canonical name for a Name/Attribute chain, through the
+        import-alias table: ``jnp.zeros`` -> 'jax.numpy.zeros'."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            base = self.aliases.get(node.id, node.id)
+            parts.append(base)
+            return ".".join(reversed(parts))
+        return None
+
+
+@dataclasses.dataclass
+class Project:
+    files: List[SourceFile]
+    axes: Tuple[str, ...]
+    axis_constants: Dict[str, str]  # constant name -> axis string
+    hatches: Dict[str, int]  # declared hatch name -> declaration line
+    hatch_decl_path: str  # rel path of the registry (for dead-flag reports)
+    # True when the registry file itself is part of the scan: the dead-flag
+    # direction is only meaningful on a whole-tree scan (a single-file scan
+    # trivially "never reads" every hatch).
+    hatch_decl_in_scan: bool = False
+
+    def package_files(self) -> List[SourceFile]:
+        return [f for f in self.files if is_package_file(f.rel)]
+
+
+def is_package_file(rel: str) -> bool:
+    return "mpi4dl_tpu/" in f"/{rel}" or rel.startswith("mpi4dl_tpu")
+
+
+class Rule:
+    """Base class; subclasses set ``name``/``description`` and implement
+    :meth:`check`.  Register instances in ``rules.RULE_TABLE``."""
+
+    name: str = ""
+    description: str = ""
+
+    def check(self, project: Project) -> List[Violation]:  # pragma: no cover
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# Ground-truth extraction (static — never imports the analyzed code)
+# ---------------------------------------------------------------------------
+
+
+def _find_file(files: Sequence[SourceFile], suffix: str) -> Optional[SourceFile]:
+    for f in files:
+        if f.rel.endswith(suffix):
+            return f
+    return None
+
+
+def _parse_fallback(modname: str) -> Optional[SourceFile]:
+    """Parse an installed package module's source without importing it."""
+    import importlib.util
+
+    try:
+        spec = importlib.util.find_spec(modname)
+    except (ImportError, ValueError):
+        return None
+    if spec is None or not spec.origin or not os.path.exists(spec.origin):
+        return None
+    with open(spec.origin, "r", encoding="utf-8") as fh:
+        return SourceFile(spec.origin, os.path.basename(spec.origin), fh.read())
+
+
+def extract_axes(files: Sequence[SourceFile]) -> Tuple[Tuple[str, ...], Dict[str, str]]:
+    """The axis vocabulary: ``mesh.AXES`` plus the AXIS_* constant table."""
+    src = _find_file(files, "mpi4dl_tpu/mesh.py") or _parse_fallback("mpi4dl_tpu.mesh")
+    axes: List[str] = []
+    constants: Dict[str, str] = {}
+    if src is None:
+        return tuple(axes), constants
+    for node in src.tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not isinstance(tgt, ast.Name):
+            continue
+        if tgt.id.startswith("AXIS_") and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            constants[tgt.id] = node.value.value
+        elif tgt.id == "AXES" and isinstance(node.value, (ast.Tuple, ast.List)):
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                    axes.append(elt.value)
+                elif isinstance(elt, ast.Name) and elt.id in constants:
+                    axes.append(constants[elt.id])
+    if not axes:
+        axes = list(constants.values())
+    return tuple(axes), constants
+
+
+def extract_hatches(files: Sequence[SourceFile]) -> Tuple[Dict[str, int], str]:
+    """Declared env hatches: every ``Hatch("NAME", ...)`` call in config.py."""
+    src = _find_file(files, "mpi4dl_tpu/config.py") or _parse_fallback(
+        "mpi4dl_tpu.config"
+    )
+    out: Dict[str, int] = {}
+    if src is None:
+        return out, ""
+    for node in ast.walk(src.tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "Hatch"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            out[node.args[0].value] = node.lineno
+    return out, src.rel
+
+
+# ---------------------------------------------------------------------------
+# Shared AST helpers for rules
+# ---------------------------------------------------------------------------
+
+
+def environ_reads(src: SourceFile) -> Iterable[Tuple[str, int]]:
+    """(name, line) for every env *read* of a string-literal key:
+    ``os.environ.get/pop/setdefault(K)``, ``os.environ[K]`` (Load ctx), and
+    ``getenv(K)``."""
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Call):
+            key = None
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in ("get", "pop", "setdefault")
+                and isinstance(f.value, ast.Attribute)
+                and f.value.attr == "environ"
+            ):
+                key = node.args[0] if node.args else None
+            elif isinstance(f, ast.Attribute) and f.attr == "getenv":
+                key = node.args[0] if node.args else None
+            elif isinstance(f, ast.Name) and f.id == "getenv":
+                key = node.args[0] if node.args else None
+            if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                yield key.value, node.lineno
+        elif (
+            isinstance(node, ast.Subscript)
+            and isinstance(node.ctx, ast.Load)
+            and isinstance(node.value, ast.Attribute)
+            and node.value.attr == "environ"
+            and isinstance(node.slice, ast.Constant)
+            and isinstance(node.slice.value, str)
+        ):
+            yield node.slice.value, node.lineno
+
+
+def is_hatch_name(name: str) -> bool:
+    return bool(_HATCH_NAME_RE.match(name))
+
+
+# ---------------------------------------------------------------------------
+# File discovery + runner
+# ---------------------------------------------------------------------------
+
+_SKIP_DIRS = {".git", "__pycache__", ".claude", "node_modules", ".github"}
+
+
+def discover(paths: Sequence[str], root: Optional[str] = None) -> List[SourceFile]:
+    root = os.path.abspath(root or os.getcwd())
+    found: List[str] = []
+    for p in paths:
+        ap = os.path.abspath(p)
+        if os.path.isfile(ap) and ap.endswith(".py"):
+            found.append(ap)
+        elif os.path.isdir(ap):
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames[:] = [d for d in dirnames if d not in _SKIP_DIRS]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        found.append(os.path.join(dirpath, fn))
+    files: List[SourceFile] = []
+    for ap in sorted(set(found)):
+        rel = os.path.relpath(ap, root)
+        with open(ap, "r", encoding="utf-8") as fh:
+            text = fh.read()
+        try:
+            files.append(SourceFile(ap, rel, text))
+        except SyntaxError as e:
+            # a file we cannot parse cannot be verified — surface it
+            raise SystemExit(f"analysis: cannot parse {rel}: {e}")
+    return files
+
+
+def build_project(paths: Sequence[str], root: Optional[str] = None) -> Project:
+    files = discover(paths, root)
+    axes, constants = extract_axes(files)
+    hatches, decl_path = extract_hatches(files)
+    return Project(
+        files=files,
+        axes=axes,
+        axis_constants=constants,
+        hatches=hatches,
+        hatch_decl_path=decl_path,
+        hatch_decl_in_scan=any(f.rel == decl_path for f in files),
+    )
+
+
+def run_rules(project: Project, rules: Sequence[Rule]) -> List[Violation]:
+    by_path = {f.rel: f for f in project.files}
+    out: List[Violation] = []
+    for rule in rules:
+        for v in rule.check(project):
+            src = by_path.get(v.path)
+            if src is not None and src.suppressed(v.rule, v.line):
+                continue
+            out.append(v)
+    out.sort(key=lambda v: (v.path, v.line, v.rule))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+
+
+def load_baseline(path: str) -> List[dict]:
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    if not isinstance(data, list):
+        raise SystemExit(f"baseline {path}: expected a JSON list")
+    return data
+
+
+def apply_baseline(
+    violations: Sequence[Violation], baseline: Sequence[dict]
+) -> Tuple[List[Violation], List[dict]]:
+    """Split into (new violations, stale baseline entries)."""
+    keys = {
+        (e.get("rule", ""), e.get("path", ""), e.get("message", ""))
+        for e in baseline
+    }
+    new = [v for v in violations if v.baseline_key not in keys]
+    seen = {v.baseline_key for v in violations}
+    stale = [
+        e
+        for e in baseline
+        if (e.get("rule", ""), e.get("path", ""), e.get("message", "")) not in seen
+    ]
+    return new, stale

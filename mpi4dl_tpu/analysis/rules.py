@@ -1,0 +1,23 @@
+"""The rule table.  Each rule lives in its own module and exports ``RULE``;
+adding a rule = adding a module here (docs/analysis.md walks through it)."""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from mpi4dl_tpu.analysis.core import Rule
+from mpi4dl_tpu.analysis.rules_collective import RULE as _collective
+from mpi4dl_tpu.analysis.rules_dtype import RULE as _dtype
+from mpi4dl_tpu.analysis.rules_env import RULE as _env
+from mpi4dl_tpu.analysis.rules_retrace import RULE as _retrace
+from mpi4dl_tpu.analysis.rules_tracer import RULE as _tracer
+
+RULE_TABLE: List[Rule] = [
+    _collective,
+    _tracer,
+    _dtype,
+    _env,
+    _retrace,
+]
+
+RULES_BY_NAME: Dict[str, Rule] = {r.name: r for r in RULE_TABLE}

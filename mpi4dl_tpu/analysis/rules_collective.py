@@ -1,0 +1,186 @@
+"""Rule ``collective-axis``: every axis name fed to a collective or a
+PartitionSpec must name an axis in ``mesh.AXES``, and statically-literal
+``ppermute`` permutation tables must be bijections.
+
+Why: a typo'd axis name ("sp" for "sph", a stale axis after a mesh redesign)
+or a non-bijective permutation table is exactly the class of bug that fails
+*silently as wrong numbers* on the chip (Rink et al., arXiv:2112.01075) — the
+reference stack's analog was hand-derived split_rank math drifting out of
+sync with the launched world size.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from mpi4dl_tpu.analysis.core import Project, Rule, SourceFile, Violation
+
+# collective -> index of the axis-name positional arg
+_COLLECTIVES = {
+    "psum": 1,
+    "pmean": 1,
+    "pmax": 1,
+    "pmin": 1,
+    "ppermute": 1,
+    "all_gather": 1,
+    "all_to_all": 1,
+    "psum_scatter": 1,
+    "axis_index": 0,
+    "axis_size": 0,
+    "pbroadcast": 1,
+    "pcast": 1,
+}
+
+_SPEC_NAMES = {"jax.sharding.PartitionSpec", "jax.PartitionSpec"}
+
+
+class CollectiveAxisRule(Rule):
+    name = "collective-axis"
+    description = (
+        "Collective/PartitionSpec axis names must be declared in mesh.AXES; "
+        "literal ppermute tables must be bijections."
+    )
+
+    def check(self, project: Project) -> List[Violation]:
+        out: List[Violation] = []
+        if not project.axes:
+            return out
+        for src in project.files:
+            out.extend(self._check_file(src, project))
+        return out
+
+    # -- helpers -----------------------------------------------------------
+    def _axis_error(
+        self, src: SourceFile, project: Project, node: ast.AST
+    ) -> Optional[str]:
+        """None when the axis expression is valid or statically unknown;
+        otherwise the offending axis string."""
+        if isinstance(node, ast.Constant):
+            if node.value is None:
+                return None
+            if isinstance(node.value, str):
+                return None if node.value in project.axes else node.value
+            return None
+        if isinstance(node, (ast.Tuple, ast.List)):
+            for elt in node.elts:
+                err = self._axis_error(src, project, elt)
+                if err is not None:
+                    return err
+            return None
+        if isinstance(node, ast.Name) and node.id in project.axis_constants:
+            ax = project.axis_constants[node.id]
+            return None if ax in project.axes else ax
+        resolved = src.resolve(node)
+        if resolved is not None and resolved.startswith("mpi4dl_tpu.mesh.AXIS_"):
+            const = resolved.rsplit(".", 1)[1]
+            ax = project.axis_constants.get(const)
+            if ax is None:
+                return f"<unknown constant {const}>"
+            return None if ax in project.axes else ax
+        return None  # dynamic expression — not statically checkable
+
+    def _check_file(self, src: SourceFile, project: Project) -> List[Violation]:
+        out: List[Violation] = []
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = src.resolve(node.func) or ""
+            tail = resolved.rsplit(".", 1)[-1]
+            # --- collectives (lax.psum(...), jax.lax.ppermute(...)) -------
+            # resolve() routes every import style (`from jax import lax`,
+            # `import jax.lax`, `from jax.lax import psum`) to jax.lax.*;
+            # the compat module re-exports pcast/shard_map with identical
+            # axis-argument shapes, so compat-routed calls are checked too.
+            if tail in _COLLECTIVES and resolved in (
+                f"jax.lax.{tail}",
+                f"lax.{tail}",
+                f"mpi4dl_tpu.compat.{tail}",
+            ):
+                axis_node = None
+                pos = _COLLECTIVES[tail]
+                if len(node.args) > pos:
+                    axis_node = node.args[pos]
+                for kw in node.keywords:
+                    if kw.arg in ("axis_name", "axis_names"):
+                        axis_node = kw.value
+                if axis_node is not None:
+                    err = self._axis_error(src, project, axis_node)
+                    if err is not None:
+                        out.append(
+                            Violation(
+                                self.name,
+                                src.rel,
+                                node.lineno,
+                                f"{tail}: axis {err!r} is not a mesh axis "
+                                f"{tuple(project.axes)}",
+                            )
+                        )
+                if tail == "ppermute":
+                    out.extend(self._check_perm(src, node))
+            # --- PartitionSpec / P(...) -----------------------------------
+            elif resolved in _SPEC_NAMES:
+                for arg in node.args:
+                    err = self._axis_error(src, project, arg)
+                    if err is not None:
+                        out.append(
+                            Violation(
+                                self.name,
+                                src.rel,
+                                node.lineno,
+                                f"PartitionSpec: axis {err!r} is not a mesh "
+                                f"axis {tuple(project.axes)}",
+                            )
+                        )
+        return out
+
+    def _check_perm(self, src: SourceFile, call: ast.Call) -> List[Violation]:
+        perm = None
+        if len(call.args) > 2:
+            perm = call.args[2]
+        for kw in call.keywords:
+            if kw.arg == "perm":
+                perm = kw.value
+        pairs = _literal_pairs(perm)
+        if pairs is None:
+            return []
+        srcs = [p[0] for p in pairs]
+        dsts = [p[1] for p in pairs]
+        problems = []
+        if len(set(srcs)) != len(srcs):
+            problems.append("duplicate sources")
+        if len(set(dsts)) != len(dsts):
+            problems.append("duplicate destinations")
+        if problems:
+            return [
+                Violation(
+                    self.name,
+                    src.rel,
+                    call.lineno,
+                    "ppermute: literal perm table is not a bijection ("
+                    + ", ".join(problems)
+                    + f"): {pairs}",
+                )
+            ]
+        return []
+
+
+def _literal_pairs(node) -> Optional[list]:
+    """[(src, dst), ...] when the perm is a fully-literal table, else None."""
+    if not isinstance(node, (ast.List, ast.Tuple)):
+        return None
+    pairs = []
+    for elt in node.elts:
+        if not isinstance(elt, (ast.Tuple, ast.List)) or len(elt.elts) != 2:
+            return None
+        vals = []
+        for item in elt.elts:
+            if isinstance(item, ast.Constant) and isinstance(item.value, int):
+                vals.append(item.value)
+            else:
+                return None
+        pairs.append(tuple(vals))
+    return pairs
+
+
+RULE = CollectiveAxisRule()

@@ -1,0 +1,160 @@
+"""Rule ``dtype-policy``: the package's bf16/fp32 policy, statically.
+
+Three checks:
+
+1. ``float64`` anywhere in the package — TPUs have no f64 units; jax silently
+   downgrades (or x64 mode silently doubles memory), either way the number
+   you measured is not the number you think.
+2. Dtype-less array constructors (``jnp.zeros(shape)``, ``jnp.full(...)``,
+   ``jnp.arange(...)``) in ``ops/`` and ``parallel/`` — these default to
+   whatever promotion produces, and a stray f32 accumulator in a bf16 ring
+   (or an i32 iota where the kernel wants f32) changes numerics between the
+   CPU test mesh and the chip.  Hot-path code states its dtype.
+3. Param-tree constructors (functions named ``init``) must build fp32:
+   storage-dtype policy (``bf_16_all``) is applied by the config's
+   ``param_dtype`` property downstream, never hard-coded at init sites.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from mpi4dl_tpu.analysis.core import (
+    Project,
+    Rule,
+    SourceFile,
+    Violation,
+    is_package_file,
+)
+
+_CONSTRUCTORS = {
+    "jax.numpy.zeros",
+    "jax.numpy.ones",
+    "jax.numpy.empty",
+    "jax.numpy.full",
+    "jax.numpy.arange",
+    "jax.numpy.linspace",
+    "jax.numpy.eye",
+}
+# (shape-ish leading args) before an optional positional dtype
+_POSITIONAL_DTYPE_AT = {
+    "zeros": 1,
+    "ones": 1,
+    "empty": 1,
+    "full": 2,
+    "eye": None,  # keyword-only in practice
+    "arange": None,
+    "linspace": None,
+}
+
+_BAD_PARAM_DTYPES = {"bfloat16", "float16", "float64", "float8_e4m3", "half"}
+
+_HOT_DIRS = ("mpi4dl_tpu/ops/", "mpi4dl_tpu/parallel/")
+
+
+class DtypePolicyRule(Rule):
+    name = "dtype-policy"
+    description = (
+        "No float64; explicit dtypes for constructors in ops/ and parallel/; "
+        "param init builds fp32 (storage dtype comes from config policy)."
+    )
+
+    def check(self, project: Project) -> List[Violation]:
+        out: List[Violation] = []
+        for src in project.files:
+            if not is_package_file(src.rel):
+                continue
+            if "mpi4dl_tpu/analysis/" in f"/{src.rel}":
+                continue  # the analyzer names dtypes in its own rule tables
+            out.extend(self._check_float64(src))
+            if any(d in src.rel for d in _HOT_DIRS):
+                out.extend(self._check_constructors(src))
+            out.extend(self._check_param_init(src))
+        return out
+
+    def _check_float64(self, src: SourceFile) -> List[Violation]:
+        out = []
+        for node in ast.walk(src.tree):
+            resolved = None
+            if isinstance(node, (ast.Attribute, ast.Name)):
+                resolved = src.resolve(node)
+            if resolved in ("jax.numpy.float64", "numpy.float64") or (
+                isinstance(node, ast.Constant) and node.value == "float64"
+            ):
+                out.append(
+                    Violation(
+                        self.name,
+                        src.rel,
+                        node.lineno,
+                        "float64 has no TPU representation (jax truncates it "
+                        "or x64 mode doubles memory) — use float32",
+                    )
+                )
+        return out
+
+    def _check_constructors(self, src: SourceFile) -> List[Violation]:
+        out = []
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = src.resolve(node.func) or ""
+            if resolved not in _CONSTRUCTORS:
+                continue
+            tail = resolved.rsplit(".", 1)[1]
+            has_dtype = any(kw.arg == "dtype" for kw in node.keywords)
+            pos = _POSITIONAL_DTYPE_AT.get(tail)
+            if pos is not None and len(node.args) > pos:
+                has_dtype = True
+            if not has_dtype:
+                out.append(
+                    Violation(
+                        self.name,
+                        src.rel,
+                        node.lineno,
+                        f"jnp.{tail}() without an explicit dtype in a hot "
+                        "path (ops/, parallel/): state the dtype",
+                    )
+                )
+        return out
+
+    def _check_param_init(self, src: SourceFile) -> List[Violation]:
+        out = []
+        for fnode in ast.walk(src.tree):
+            if not isinstance(fnode, ast.FunctionDef) or fnode.name != "init":
+                continue
+            for node in ast.walk(fnode):
+                if not isinstance(node, ast.Call):
+                    continue
+                resolved = src.resolve(node.func) or ""
+                if not (
+                    resolved in _CONSTRUCTORS
+                    or resolved.startswith("jax.random.")
+                ):
+                    continue
+                for kw in node.keywords:
+                    if kw.arg != "dtype":
+                        continue
+                    dt = kw.value
+                    dt_name = None
+                    if isinstance(dt, ast.Attribute):
+                        dt_name = dt.attr
+                    elif isinstance(dt, ast.Constant) and isinstance(
+                        dt.value, str
+                    ):
+                        dt_name = dt.value
+                    if dt_name in _BAD_PARAM_DTYPES:
+                        out.append(
+                            Violation(
+                                self.name,
+                                src.rel,
+                                node.lineno,
+                                f"param init builds {dt_name} — params are "
+                                "fp32 at init; storage dtype comes from "
+                                "config.param_dtype",
+                            )
+                        )
+        return out
+
+
+RULE = DtypePolicyRule()

@@ -1,0 +1,71 @@
+"""Rule ``env-hatch``: ``MPI4DL_*`` environment-hatch hygiene.
+
+Both directions are enforced against the central ``config.HATCHES`` registry:
+
+- every environment *read* of an ``MPI4DL_*`` name must reference a declared
+  hatch (an undeclared read is a knob nobody can discover — the reference
+  stack's scattered-parser problem reborn as env vars);
+- every declared hatch must be read somewhere in the scanned tree (a dead
+  flag documents behaviour the code no longer has).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Set, Tuple
+
+from mpi4dl_tpu.analysis.core import (
+    Project,
+    Rule,
+    Violation,
+    environ_reads,
+    is_hatch_name,
+)
+
+
+class EnvHatchRule(Rule):
+    name = "env-hatch"
+    description = (
+        "MPI4DL_* env reads must reference config.HATCHES; every declared "
+        "hatch must be read somewhere (dead-flag detection)."
+    )
+
+    def check(self, project: Project) -> List[Violation]:
+        out: List[Violation] = []
+        read_names: Set[str] = set()
+        reads: List[Tuple[str, str, int]] = []  # (name, rel, line)
+        for src in project.files:
+            for name, line in environ_reads(src):
+                if is_hatch_name(name):
+                    read_names.add(name)
+                    reads.append((name, src.rel, line))
+
+        declared: Dict[str, int] = project.hatches
+        for name, rel, line in reads:
+            if declared and name not in declared:
+                out.append(
+                    Violation(
+                        self.name,
+                        rel,
+                        line,
+                        f"env hatch {name!r} is not declared in "
+                        "config.HATCHES (add a Hatch entry with a default "
+                        "and one-line doc)",
+                    )
+                )
+        if not project.hatch_decl_in_scan:
+            return out  # partial scan: dead-flag direction is meaningless
+        for name, decl_line in declared.items():
+            if name not in read_names:
+                out.append(
+                    Violation(
+                        self.name,
+                        project.hatch_decl_path,
+                        decl_line,
+                        f"declared hatch {name!r} is never read in the "
+                        "scanned tree (dead flag — remove it or wire it up)",
+                    )
+                )
+        return out
+
+
+RULE = EnvHatchRule()

@@ -1,0 +1,162 @@
+"""Rule ``retrace``: compile-time cache hazards.
+
+Two checks:
+
+1. Module-level ``jnp`` array construction.  A device array created at import
+   time is closed over by every function that references it, baked into each
+   trace as a constant: it pins device memory for the process lifetime,
+   defeats donation, and a "small" table silently becomes a big XLA constant
+   in every executable.  Build it with numpy (traced as a literal once) or
+   inside the jitted function.
+2. ``jit(f, static_argnums/static_argnames=...)`` where the corresponding
+   parameter's default is a mutable literal (list/dict/set).  Static args are
+   hashed for the compile cache; an unhashable default raises only on the
+   first *defaulted* call — typically on the chip, hours after the CPU tests
+   passed (they always passed the argument explicitly).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from mpi4dl_tpu.analysis.core import (
+    Project,
+    Rule,
+    SourceFile,
+    Violation,
+    is_package_file,
+)
+
+_MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                     ast.SetComp)
+
+
+class RetraceRule(Rule):
+    name = "retrace"
+    description = (
+        "No module-level jnp arrays (per-trace baked constants); static args "
+        "must be hashable (no mutable-literal defaults)."
+    )
+
+    def check(self, project: Project) -> List[Violation]:
+        out: List[Violation] = []
+        for src in project.files:
+            if not is_package_file(src.rel):
+                continue
+            out.extend(self._check_module_arrays(src))
+            out.extend(self._check_static_args(src))
+        return out
+
+    # -- module-level jnp arrays ------------------------------------------
+    def _check_module_arrays(self, src: SourceFile) -> List[Violation]:
+        out = []
+        for node in src.tree.body:  # module level only, by construction
+            value = None
+            if isinstance(node, ast.Assign):
+                value = node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                value = node.value
+            if value is None or not isinstance(value, ast.Call):
+                continue
+            resolved = src.resolve(value.func) or ""
+            if resolved.startswith("jax.numpy."):
+                out.append(
+                    Violation(
+                        self.name,
+                        src.rel,
+                        node.lineno,
+                        f"module-level {resolved}() creates a device array "
+                        "at import: baked into every trace as a constant "
+                        "(use numpy here, or construct inside the function)",
+                    )
+                )
+        return out
+
+    # -- unhashable static args -------------------------------------------
+    def _check_static_args(self, src: SourceFile) -> List[Violation]:
+        out = []
+        funcs: Dict[str, ast.FunctionDef] = {
+            n.name: n
+            for n in ast.walk(src.tree)
+            if isinstance(n, ast.FunctionDef)
+        }
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = src.resolve(node.func) or ""
+            if resolved.split(".")[-1] != "jit":
+                continue
+            static_kw = {
+                kw.arg: kw.value
+                for kw in node.keywords
+                if kw.arg in ("static_argnums", "static_argnames")
+            }
+            if not static_kw or not node.args:
+                continue
+            target = node.args[0]
+            fdef = (
+                funcs.get(target.id) if isinstance(target, ast.Name) else None
+            )
+            if fdef is None:
+                continue
+            params = fdef.args.args
+            defaults = fdef.args.defaults
+            # align defaults to trailing params
+            default_of: Dict[str, ast.AST] = {}
+            for p, d in zip(params[len(params) - len(defaults):], defaults):
+                default_of[p.arg] = d
+            flagged: List[str] = []
+            nums = static_kw.get("static_argnums")
+            if nums is not None:
+                for idx in _int_literals(nums):
+                    if 0 <= idx < len(params):
+                        name = params[idx].arg
+                        d = default_of.get(name)
+                        if d is not None and isinstance(d, _MUTABLE_LITERALS):
+                            flagged.append(name)
+            names = static_kw.get("static_argnames")
+            if names is not None:
+                for name in _str_literals(names):
+                    d = default_of.get(name)
+                    if d is not None and isinstance(d, _MUTABLE_LITERALS):
+                        flagged.append(name)
+            for name in flagged:
+                out.append(
+                    Violation(
+                        self.name,
+                        src.rel,
+                        node.lineno,
+                        f"static arg {name!r} of {fdef.name!r} defaults to a "
+                        "mutable literal — unhashable for the jit cache; "
+                        "use a tuple/frozenset or require the argument",
+                    )
+                )
+        return out
+
+
+def _int_literals(node: ast.AST) -> List[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, int)
+        ]
+    return []
+
+
+def _str_literals(node: ast.AST) -> List[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        ]
+    return []
+
+
+RULE = RetraceRule()

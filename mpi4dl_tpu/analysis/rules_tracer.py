@@ -1,0 +1,252 @@
+"""Rule ``tracer-leak``: host-sync and trace-impurity hazards inside
+functions reachable from a ``jax.jit`` / ``shard_map`` call site.
+
+``float(x)`` / ``x.item()`` / ``np.asarray(x)`` on a tracer abort the trace
+(ConcretizationError) — or worse, silently constant-fold when x is a numpy
+value captured by closure.  ``time.time()`` and ``np.random.*`` are traced
+ONCE and baked into the compiled program, the classic "my random numbers
+never change" bug.  ``if``/``while`` on a jnp value is a device sync per
+step.  None of these fail on CPU test shapes; all of them bite on the chip.
+
+Reachability is a module-level approximation: a scope-aware call graph over
+the functions defined in each module (a call resolves lexically — the
+caller's own nested defs first, then enclosing scopes, then module level —
+so same-named nested helpers like the per-factory ``tick``/``body`` closures
+common in this codebase stay distinct).  Roots are functions passed to or
+decorated with ``jit``, ``shard_map``, ``checkpoint``/``remat``,
+``lax.scan``/``cond``/``switch``/``while_loop``/``fori_loop``,
+``grad``/``value_and_grad``, ``vmap``/``pmap``, or ``eval_shape``.  Nested
+defs of a reachable function are reachable (they run under the same trace
+when called).  Attribute calls (``self.f()``) and cross-module calls are
+not followed — see docs/analysis.md.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from mpi4dl_tpu.analysis.core import Project, Rule, SourceFile, Violation
+
+# callables whose function-valued arguments run under a trace
+_TRACE_ENTRY = {
+    "jax.jit",
+    "jax.lax.scan",
+    "jax.lax.cond",
+    "jax.lax.switch",
+    "jax.lax.while_loop",
+    "jax.lax.fori_loop",
+    "jax.lax.map",
+    "jax.lax.associative_scan",
+    "jax.checkpoint",
+    "jax.remat",
+    "jax.grad",
+    "jax.value_and_grad",
+    "jax.vmap",
+    "jax.pmap",
+    "jax.eval_shape",
+    "jax.shard_map",
+    "jax.experimental.shard_map.shard_map",
+    "mpi4dl_tpu.compat.shard_map",
+}
+
+
+def _is_trace_entry(src: SourceFile, func_node: ast.AST) -> bool:
+    resolved = src.resolve(func_node)
+    if resolved is None:
+        return False
+    if resolved == "functools.partial":
+        return False  # handled at the decorator site
+    return resolved in _TRACE_ENTRY or resolved.split(".")[-1] in (
+        "jit",
+        "shard_map",
+        "checkpoint",
+        "remat",
+    )
+
+
+class _FuncInfo:
+    """One function definition (module-level or nested).  ``children`` maps a
+    bare name to the defs nested directly in this scope, so call resolution
+    is lexical and same-named closures in different factories stay apart."""
+
+    def __init__(self, node: Optional[ast.FunctionDef], parent: "Optional[_FuncInfo]"):
+        self.node = node  # None for the synthetic module scope
+        self.parent = parent
+        self.children: Dict[str, List["_FuncInfo"]] = {}
+        self.calls: Set[str] = set()  # bare names called / referenced
+
+    def resolve(self, name: str) -> List["_FuncInfo"]:
+        scope: Optional[_FuncInfo] = self
+        while scope is not None:
+            if name in scope.children:
+                return scope.children[name]
+            scope = scope.parent
+        return []
+
+
+class TracerLeakRule(Rule):
+    name = "tracer-leak"
+    description = (
+        "float()/.item()/np.asarray/time.time()/np.random/jnp-valued "
+        "control flow inside functions reachable from jit/shard_map."
+    )
+
+    def check(self, project: Project) -> List[Violation]:
+        out: List[Violation] = []
+        for src in project.files:
+            out.extend(self._check_file(src))
+        return out
+
+    def _check_file(self, src: SourceFile) -> List[Violation]:
+        roots = self._collect(src)
+        reachable = self._reach(roots)
+        out: List[Violation] = []
+        for info in reachable:
+            out.extend(self._scan_body(src, info.node))
+        return out
+
+    # -- collection --------------------------------------------------------
+    def _collect(self, src: SourceFile) -> List[_FuncInfo]:
+        """Build the scope tree and return the root infos (functions that
+        enter a trace via decorator or by being passed to a trace entry)."""
+        module = _FuncInfo(None, None)
+        direct_roots: List[_FuncInfo] = []
+        # (scope the reference appears in, referenced name)
+        root_refs: List[Tuple[_FuncInfo, str]] = []
+        rule = self
+
+        class V(ast.NodeVisitor):
+            def __init__(self):
+                self.stack: List[_FuncInfo] = [module]
+
+            def visit_FunctionDef(self, node: ast.FunctionDef):
+                parent = self.stack[-1]
+                info = _FuncInfo(node, parent)
+                parent.children.setdefault(node.name, []).append(info)
+                # a nested def runs under the parent's trace when called
+                parent.calls.add(node.name)
+                for dec in node.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    if _is_trace_entry(src, target):
+                        direct_roots.append(info)
+                    if (
+                        isinstance(dec, ast.Call)
+                        and src.resolve(dec.func) == "functools.partial"
+                        and dec.args
+                        and _is_trace_entry(src, dec.args[0])
+                    ):
+                        direct_roots.append(info)
+                self.stack.append(info)
+                self.generic_visit(node)
+                self.stack.pop()
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_Call(self, node: ast.Call):
+                cur = self.stack[-1]
+                if isinstance(node.func, ast.Name):
+                    cur.calls.add(node.func.id)
+                # jit(f) / shard_map(f, ...): every Name argument roots the
+                # function that name resolves to IN THIS SCOPE
+                if _is_trace_entry(src, node.func):
+                    for arg in list(node.args) + [k.value for k in node.keywords]:
+                        if isinstance(arg, ast.Name):
+                            root_refs.append((cur, arg.id))
+                self.generic_visit(node)
+
+        V().visit(src.tree)
+        for scope, name in root_refs:
+            direct_roots.extend(scope.resolve(name))
+        return direct_roots
+
+    @staticmethod
+    def _reach(roots: List[_FuncInfo]) -> List[_FuncInfo]:
+        seen: Dict[int, _FuncInfo] = {}
+        work = list(roots)
+        while work:
+            info = work.pop()
+            if id(info) in seen:
+                continue
+            seen[id(info)] = info
+            for name in info.calls:
+                for callee in info.resolve(name):
+                    if id(callee) not in seen:
+                        work.append(callee)
+        return list(seen.values())
+
+    # -- body scan ---------------------------------------------------------
+    def _scan_body(
+        self, src: SourceFile, fnode: ast.FunctionDef
+    ) -> List[Violation]:
+        out: List[Violation] = []
+        fname = fnode.name
+
+        def flag(node: ast.AST, what: str):
+            out.append(
+                Violation(
+                    self.name,
+                    src.rel,
+                    node.lineno,
+                    f"{what} inside jit-reachable function {fname!r}",
+                )
+            )
+
+        for node in _walk_own_body(fnode):
+            if isinstance(node, ast.Call):
+                f = node.func
+                resolved = src.resolve(f) or ""
+                if isinstance(f, ast.Name) and f.id == "float" and node.args:
+                    # float(literal) is fine; float(expr) is a host sync
+                    if not isinstance(node.args[0], ast.Constant):
+                        flag(node, "float() host sync")
+                elif isinstance(f, ast.Attribute) and f.attr == "item" \
+                        and not node.args:
+                    flag(node, ".item() host sync")
+                elif resolved in ("numpy.asarray", "numpy.array"):
+                    flag(node, f"{resolved}() materializes the tracer on host")
+                elif resolved in (
+                    "time.time",
+                    "time.perf_counter",
+                    "time.monotonic",
+                ):
+                    flag(node, f"{resolved}() is traced once and baked in")
+                elif resolved.startswith("numpy.random."):
+                    flag(
+                        node,
+                        f"{resolved}() is traced once and baked in "
+                        "(use jax.random with a threaded key)",
+                    )
+            elif isinstance(node, (ast.If, ast.While)):
+                if self._test_on_jnp(src, node.test):
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    flag(
+                        node,
+                        f"`{kind}` on a jnp value forces a device sync "
+                        "(use lax.cond / lax.while_loop)",
+                    )
+        return out
+
+    @staticmethod
+    def _test_on_jnp(src: SourceFile, test: ast.AST) -> bool:
+        for node in ast.walk(test):
+            if isinstance(node, ast.Call):
+                resolved = src.resolve(node.func) or ""
+                if resolved.startswith("jax.numpy."):
+                    return True
+        return False
+
+
+def _walk_own_body(fnode: ast.FunctionDef):
+    """Walk a function's body WITHOUT descending into nested defs — those
+    are separate graph nodes, scanned iff reachable (always true when the
+    parent is, but scanning them here too would double-report)."""
+    work = list(ast.iter_child_nodes(fnode))
+    while work:
+        node = work.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            work.extend(ast.iter_child_nodes(node))
+
+
+RULE = TracerLeakRule()

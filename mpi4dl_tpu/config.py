@@ -9,7 +9,90 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
+
+
+# ---------------------------------------------------------------------------
+# Environment-hatch registry
+#
+# Every ``MPI4DL_*`` environment escape hatch the package (or its benches /
+# tests) reads must be declared here.  The static analyzer
+# (mpi4dl_tpu/analysis, rule ``env-hatch``) enforces both directions: an
+# ``os.environ`` read of an undeclared ``MPI4DL_*`` name is a violation, and a
+# declared hatch that is never read anywhere is a dead flag.  The README's
+# "Environment hatches" section is generated from this table
+# (:func:`hatches_markdown`).
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Hatch:
+    """One declared environment escape hatch."""
+
+    name: str
+    default: str  # the effective default when the variable is unset
+    doc: str
+    internal: bool = False  # process-internal plumbing, not a user knob
+
+
+HATCHES: Dict[str, Hatch] = {
+    h.name: h
+    for h in (
+        Hatch("MPI4DL_SQRT_GROUPS", "0",
+              "Remat cell-group count for remat='sqrt'; 0 = auto (~sqrt(n); "
+              "bench.py pins 16 for ResNet — PERF_NOTES r5)."),
+        Hatch("MPI4DL_REMAT_OPS", "0",
+              "1 = per-op checkpoints inside composite cells under ANY outer "
+              "remat level (the ResNet-2048 memory frontier; bench auto-"
+              "retries with it on OOM)."),
+        Hatch("MPI4DL_NO_PHASE_DX", "0",
+              "1 = strided convs keep XLA's lhs-dilation backward instead of "
+              "the phase-decomposed dx path."),
+        Hatch("MPI4DL_NO_HSTRIPE", "0",
+              "1 = tiny-channel huge-spatial convs keep the plain XLA conv "
+              "instead of H-striped patching."),
+        Hatch("MPI4DL_HSTRIPE_RUN", "auto",
+              "Block-level H-striping control: 0 = off, 1 = on (silences the "
+              "train-mode BN stats warning), auto = on with warning."),
+        Hatch("MPI4DL_HSTRIPE_EXACT", "0",
+              "1 = striped train-mode BN uses GLOBAL batch statistics "
+              "(exactness at ~1 extra prefix forward per BN)."),
+        Hatch("MPI4DL_NO_PACK", "0",
+              "1 = disable boundary packing of D2 fused-run margins "
+              "(A/B hatch; measured a no-op on v5e — PERF_NOTES r5)."),
+        Hatch("MPI4DL_LANE_PAD", "0",
+              "1 = pad AmoebaNet bottleneck mid-channels to 128 lanes "
+              "(vector-lane utilization A/B)."),
+        Hatch("MPI4DL_PALLAS_CONV", "0",
+              "1 = route eligible spatial convs through the Pallas "
+              "implicit-GEMM kernel in bench.py A/Bs (off: XLA wins at the "
+              "step level — PERF_NOTES r4)."),
+        Hatch("MPI4DL_TPU_TESTS", "0",
+              "1 = opt in to real-TPU subprocess tests (the tunnel is slow "
+              "and intermittently down)."),
+        Hatch("MPI4DL_TPU_NATIVE_DIR", "<alongside data_native.py>",
+              "Directory holding the prebuilt native data-loader artifacts."),
+        Hatch("MPI4DL_TPU_JAX_CACHE", "/tmp/mpi4dl_tpu_jax_cache",
+              "Persistent XLA compilation-cache directory for the test "
+              "suite."),
+        Hatch("_MPI4DL_DRYRUN_INNER", "0",
+              "Internal: marks the re-exec'd inner process of "
+              "__graft_entry__.dryrun_multichip.", internal=True),
+    )
+}
+
+
+def hatches_markdown(include_internal: bool = False) -> str:
+    """Render the registry as the README's "Environment hatches" table."""
+    lines = [
+        "| Hatch | Default | Effect |",
+        "| --- | --- | --- |",
+    ]
+    for h in HATCHES.values():
+        if h.internal and not include_internal:
+            continue
+        lines.append(f"| `{h.name}` | `{h.default}` | {h.doc} |")
+    return "\n".join(lines)
 
 
 @dataclasses.dataclass

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 from typing import Optional
+from mpi4dl_tpu.mesh import AXIS_SPH, AXIS_SPW
 
 
 @dataclasses.dataclass(frozen=True)
@@ -135,9 +136,9 @@ def spatial_ctx_for(slice_method: str, num_spatial_parts: int, **kw) -> SpatialC
     """Build a SpatialCtx from the reference's (slice_method, num_spatial_parts)
     config vocabulary (reference parser.py:21-143)."""
     if slice_method == "vertical":
-        return SpatialCtx(axis_w="spw", grid_w=num_spatial_parts, **kw)
+        return SpatialCtx(axis_w=AXIS_SPW, grid_w=num_spatial_parts, **kw)
     if slice_method == "horizontal":
-        return SpatialCtx(axis_h="sph", grid_h=num_spatial_parts, **kw)
+        return SpatialCtx(axis_h=AXIS_SPH, grid_h=num_spatial_parts, **kw)
     if slice_method == "square":
         import math
 
@@ -146,7 +147,7 @@ def spatial_ctx_for(slice_method: str, num_spatial_parts: int, **kw) -> SpatialC
             raise ValueError(
                 f"square slicing needs a perfect-square part count, got {num_spatial_parts}"
             )
-        return SpatialCtx(axis_h="sph", axis_w="spw", grid_h=g, grid_w=g, **kw)
+        return SpatialCtx(axis_h=AXIS_SPH, axis_w=AXIS_SPW, grid_h=g, grid_w=g, **kw)
     raise ValueError(f"unknown slice_method {slice_method!r}")
 
 

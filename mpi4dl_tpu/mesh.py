@@ -27,7 +27,16 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXES = ("data", "stage", "sph", "spw")
+# Canonical mesh-axis names.  All collective calls and PartitionSpecs in the
+# package reference these constants (not raw strings) so the static analyzer
+# (mpi4dl_tpu/analysis, rule `collective-axis`) can verify every axis name
+# against this single source of truth.
+AXIS_DATA = "data"
+AXIS_STAGE = "stage"
+AXIS_SPH = "sph"
+AXIS_SPW = "spw"
+
+AXES = (AXIS_DATA, AXIS_STAGE, AXIS_SPH, AXIS_SPW)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -108,7 +108,7 @@ def make_seq_cp_train_step(blocks, mesh, axis_name: str, n: int, lr: float,
     1/n if the loss's internal pmean were ever removed (verified: switching
     to local-loss + post-hoc pmean yields n-times-too-large gradients,
     because the pbroadcast transpose psums the local-loss grads first)."""
-    from jax import shard_map
+    from mpi4dl_tpu.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     spec = P(None, axis_name, None)

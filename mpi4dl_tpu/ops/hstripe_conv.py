@@ -26,6 +26,7 @@ import os
 import jax
 import jax.numpy as jnp
 from jax import lax
+from mpi4dl_tpu.mesh import AXIS_SPH
 
 _log = logging.getLogger("mpi4dl_tpu")
 
@@ -121,7 +122,7 @@ def hstripe_conv2d(x: jax.Array, w: jax.Array,
         )
         return y.reshape(n, sh, ow * cout)
 
-    ys = lax.map(piece, jnp.arange(stripes))        # [S, N, sh, OW·Cout]
+    ys = lax.map(piece, jnp.arange(stripes, dtype=jnp.int32))        # [S, N, sh, OW·Cout]
     out = ys.transpose(1, 0, 2, 3).reshape(n, stripes * sh, ow * cout)
     if extra:
         out = out[:, :oh]
@@ -296,7 +297,7 @@ def hstripe_layer_run(layers, params_seq, x, ctx):
         # rather than degenerate into per-row scan steps (advisor r4).
         return None  # caller takes its normal path
     sp_fake = SpatialCtx(
-        axis_h="sph", grid_h=stripes, bn_cross_tile=False, stat_local=True
+        axis_h=AXIS_SPH, grid_h=stripes, bn_cross_tile=False, stat_local=True
     )
     sctx = ctx.with_spatial(sp_fake)
     leaves = jax.tree.leaves(params_seq)
@@ -351,7 +352,7 @@ def hstripe_layer_run(layers, params_seq, x, ctx):
                         jnp.sum(jnp.square(t.astype(acc_dt)), axis=(0, 1, 2)),
                     )
 
-                sA, ssA = lax.map(stat_piece, jnp.arange(stripes))
+                sA, ssA = lax.map(stat_piece, jnp.arange(stripes, dtype=jnp.int32))
                 s, ss = jnp.sum(sA, axis=0), jnp.sum(ssA, axis=0)
             cnt = jnp.asarray(n * h * w, acc_dt)
             mean = s / cnt
@@ -376,7 +377,7 @@ def hstripe_layer_run(layers, params_seq, x, ctx):
         )
         return y.reshape(n, sh, y.shape[2] * y.shape[3]), stats
 
-    ys, stats = lax.map(piece, jnp.arange(stripes))
+    ys, stats = lax.map(piece, jnp.arange(stripes, dtype=jnp.int32))
     oc = ys.shape[3] // w
     if ctx.bn_sink is not None:
         for leaf, s in zip(leaves, stats):

@@ -47,6 +47,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from mpi4dl_tpu.compat import pcast
+
 _NEG_INF = -1e30  # large-negative instead of -inf: exp() of it is exactly 0
                   # and max() never produces nan from (-inf) - (-inf).
 _LANES = 128
@@ -203,8 +205,8 @@ def _reference_mlo(q, k, v, q_off, k_off, causal, scale):
     s = jnp.einsum("bqd,bkd->bqk", qf, k.astype(jnp.float32))
     if causal:
         t_q, t_k = q.shape[1], k.shape[1]
-        q_pos = q_off + jnp.arange(t_q)
-        k_pos = k_off + jnp.arange(t_k)
+        q_pos = q_off + jnp.arange(t_q, dtype=jnp.int32)
+        k_pos = k_off + jnp.arange(t_k, dtype=jnp.int32)
         s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, _NEG_INF)
     m = jnp.max(s, axis=-1)
     p = jnp.where(s > _NEG_INF * 0.5, jnp.exp(s - m[..., None]), 0.0)
@@ -263,8 +265,8 @@ def _block_flash_bwd(causal, scale, tq, tk, interpret, res, cts):
     tk_pad = nk * tk_c - t_k
     kf_p = jnp.pad(kf, ((0, 0), (0, tk_pad), (0, 0)))
     vf_p = jnp.pad(vf, ((0, 0), (0, tk_pad), (0, 0)))
-    k_ids = jnp.arange(nk * tk_c)
-    q_pos = q_off + jnp.arange(t_q)
+    k_ids = jnp.arange(nk * tk_c, dtype=jnp.int32)
+    q_pos = q_off + jnp.arange(t_q, dtype=jnp.int32)
 
     def tile(carry, inp):
         dq_acc, = carry
@@ -293,7 +295,7 @@ def _block_flash_bwd(causal, scale, tq, tk, interpret, res, cts):
         for a in (q, k, v, do):
             vma = vma | frozenset(jax.typeof(a).vma)
         if vma:
-            dq0 = lax.pcast(dq0, tuple(vma), to="varying")
+            dq0 = pcast(dq0, tuple(vma), to="varying")
     except (AttributeError, TypeError):
         pass
     (dq,), (dks, dvs) = lax.scan(tile, (dq0,), (kts, vts, idts))

@@ -32,6 +32,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from mpi4dl_tpu.compat import pcast
+
 from mpi4dl_tpu.ops.halo import HaloSpec, halo_exchange_1d
 
 
@@ -130,7 +132,7 @@ def ring_attention(
             return flash_attention_local(
                 q, k, v, causal=causal, scale=scale, interpret=interpret
             )
-        s = block_scores(k, jnp.arange(t), jnp.arange(t))
+        s = block_scores(k, jnp.arange(t, dtype=jnp.int32), jnp.arange(t, dtype=jnp.int32))
         out = jnp.einsum(
             "bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), v.astype(jnp.float32)
         )
@@ -144,12 +146,12 @@ def ring_attention(
         )
 
     my = lax.axis_index(axis_name)
-    q_pos = my * t + jnp.arange(t)
+    q_pos = my * t + jnp.arange(t, dtype=jnp.int32)
     perm = [(i, (i + 1) % n) for i in range(n)]  # ring: block from prev device
 
     def body(carry, _):
         kblk, vblk, src, m, l, o = carry
-        k_pos = src * t + jnp.arange(t)
+        k_pos = src * t + jnp.arange(t, dtype=jnp.int32)
         s = block_scores(kblk, q_pos, k_pos)  # [B, H, Tq, Tk]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         # exp(-inf - -inf) guard: rows with no valid keys yet keep m=-inf.
@@ -168,7 +170,7 @@ def ring_attention(
     # Accumulators start device-uniform but become device-varying in the loop:
     # mark them varying up front (shard_map vma tracking requires carry types
     # to be loop-invariant; same pattern as the pipeline scans).
-    vcast = lambda t_: lax.pcast(t_, (axis_name,), to="varying")
+    vcast = lambda t_: pcast(t_, (axis_name,), to="varying")
     m0 = vcast(jnp.full((b, h, t), -jnp.inf, jnp.float32))
     l0 = vcast(jnp.zeros((b, h, t), jnp.float32))
     o0 = vcast(jnp.zeros((b, h, t, d), jnp.float32))
@@ -224,7 +226,7 @@ def _ring_attention_flash(q, k, v, axis_name, n, causal, scale, interpret):
         src = lax.ppermute(src, axis_name, perm)
         return (kblk, vblk, src, m, l, o), None
 
-    vcast = lambda t_: lax.pcast(t_, (axis_name,), to="varying")
+    vcast = lambda t_: pcast(t_, (axis_name,), to="varying")
     from mpi4dl_tpu.ops.pallas_attention import _NEG_INF
 
     m0 = vcast(jnp.full((b * h, t), _NEG_INF, jnp.float32))

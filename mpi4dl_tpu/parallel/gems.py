@@ -35,7 +35,9 @@ from typing import Any, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from mpi4dl_tpu.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from mpi4dl_tpu.layer_ctx import ApplyCtx
@@ -47,6 +49,7 @@ from mpi4dl_tpu.parallel.stage_common import (
     scatter_stage_stats,
 )
 from mpi4dl_tpu.train import Optimizer
+from mpi4dl_tpu.mesh import AXIS_DATA, AXIS_STAGE
 
 
 def make_gems_train_step(
@@ -68,12 +71,12 @@ def make_gems_train_step(
     Pn = parts
     ctx = ApplyCtx(train=True)
     mirror_perm = [(i, S - 1 - i) for i in range(S)]
-    grad_axes: Tuple[str, ...] = ("data",) if with_data_axis else ()
+    grad_axes: Tuple[str, ...] = (AXIS_DATA,) if with_data_axis else ()
 
     with_stats = bn_stats and part.stat_max > 0
     branches = make_stage_branches(
         part, ctx, compute_dtype, remat, with_stats,
-        vary_axes=("stage",) + grad_axes,
+        vary_axes=(AXIS_STAGE,) + grad_axes,
     )
 
     def sharded_step(param_row, opt_state, x, labels):
@@ -86,23 +89,23 @@ def make_gems_train_step(
 
         def loss_and_metrics(flat_params):
             # The reverse replica's params: device d gets stage S-1-d's row.
-            mirror_params = lax.ppermute(flat_params, "stage", mirror_perm)
+            mirror_params = lax.ppermute(flat_params, AXIS_STAGE, mirror_perm)
             loss_acc, acc_acc, stA, stB = gems_dual_scan(
                 part, branches, flat_params, mirror_params, xs, ys,
-                vary_axes=("stage",) + grad_axes,
+                vary_axes=(AXIS_STAGE,) + grad_axes,
                 from_probs=from_probs,
                 compute_dtype=compute_dtype,
             )
             denom = 2 * times * Pn
-            loss = lax.psum(loss_acc, "stage") / denom
-            acc = lax.psum(acc_acc, "stage") / denom
+            loss = lax.psum(loss_acc, AXIS_STAGE) / denom
+            acc = lax.psum(acc_acc, AXIS_STAGE) / denom
             if grad_axes:
                 loss = lax.pmean(loss, grad_axes)
                 acc = lax.pmean(acc, grad_axes)
             # Stream B's stats belong to stage S-1-d: route them home via the
             # mirror permute, then average over all 2*times*Pn deposits (each
             # stream contributed times*Pn).
-            stats = (stA + lax.ppermute(stB, "stage", mirror_perm)) / denom
+            stats = (stA + lax.ppermute(stB, AXIS_STAGE, mirror_perm)) / denom
             return loss, (acc, stats)
 
         (loss, (acc, stats)), grads = jax.value_and_grad(
@@ -117,8 +120,8 @@ def make_gems_train_step(
             new_flat = scatter_stage_stats(part, new_flat, stats)
         return new_flat[None], new_opt, {"loss": loss, "accuracy": acc}
 
-    pspec = P("stage", None)
-    dspec = P("data") if with_data_axis else P()
+    pspec = P(AXIS_STAGE, None)
+    dspec = P(AXIS_DATA) if with_data_axis else P()
     smapped = shard_map(
         sharded_step,
         mesh=mesh,

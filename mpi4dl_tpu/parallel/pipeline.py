@@ -35,7 +35,9 @@ from typing import Any, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from mpi4dl_tpu.compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from mpi4dl_tpu.layer_ctx import ApplyCtx
@@ -46,6 +48,7 @@ from mpi4dl_tpu.parallel.stage_common import (
     scatter_stage_stats,
 )
 from mpi4dl_tpu.train import Optimizer
+from mpi4dl_tpu.mesh import AXIS_DATA, AXIS_STAGE
 
 
 @dataclasses.dataclass
@@ -83,11 +86,11 @@ def make_pipeline_train_step(
     Pn = parts
     ctx = ApplyCtx(train=True)
 
-    grad_axes: Tuple[str, ...] = ("data",) if with_data_axis else ()
+    grad_axes: Tuple[str, ...] = (AXIS_DATA,) if with_data_axis else ()
     with_stats = bn_stats and part.stat_max > 0
     branches = make_stage_branches(
         part, ctx, compute_dtype, remat, with_stats,
-        vary_axes=("stage",) + grad_axes,
+        vary_axes=(AXIS_STAGE,) + grad_axes,
     )
 
     def sharded_step(param_row, opt_state, x, labels):
@@ -100,14 +103,14 @@ def make_pipeline_train_step(
         def loss_and_metrics(flat_params):
             loss_acc, acc_acc, st_acc = gpipe_scan(
                 part, branches, flat_params, x_parts, y_parts,
-                vary_axes=("stage",) + grad_axes,
+                vary_axes=(AXIS_STAGE,) + grad_axes,
                 from_probs=from_probs,
                 compute_dtype=compute_dtype,
             )
             # Only the last stage accumulated; psum broadcasts to all stages
             # (and sums over data-parallel groups' mean below).
-            loss = lax.psum(loss_acc, "stage") / Pn
-            acc = lax.psum(acc_acc, "stage") / Pn
+            loss = lax.psum(loss_acc, AXIS_STAGE) / Pn
+            acc = lax.psum(acc_acc, AXIS_STAGE) / Pn
             if grad_axes:
                 loss = lax.pmean(loss, grad_axes)
                 acc = lax.pmean(acc, grad_axes)
@@ -128,8 +131,8 @@ def make_pipeline_train_step(
             new_flat = scatter_stage_stats(part, new_flat, stats)
         return new_flat[None], new_opt, {"loss": loss, "accuracy": acc}
 
-    pspec = P("stage", None)
-    dspec = P("data") if with_data_axis else P()
+    pspec = P(AXIS_STAGE, None)
+    dspec = P(AXIS_DATA) if with_data_axis else P()
     smapped = shard_map(
         sharded_step,
         mesh=mesh,
@@ -154,7 +157,7 @@ def init_pipeline_state(
     """Pack params into the stage-sharded buffer and init the optimizer
     stage-locally (opt state shares the buffer's sharding)."""
     buf = part.pack_params(params_list)
-    sharding = NamedSharding(mesh, P("stage", None))
+    sharding = NamedSharding(mesh, P(AXIS_STAGE, None))
     buf = jax.device_put(buf, sharding)
     opt_state = jax.tree.map(
         lambda z: jax.device_put(z, sharding), optimizer.init(buf)

@@ -53,7 +53,9 @@ from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from mpi4dl_tpu.compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from mpi4dl_tpu.cells import CellModel
@@ -78,6 +80,7 @@ from mpi4dl_tpu.parallel.stage_common import (
     scatter_stage_stats,
 )
 from mpi4dl_tpu.train import Optimizer, spatial_partition_spec
+from mpi4dl_tpu.mesh import AXIS_DATA, AXIS_STAGE
 
 
 @dataclasses.dataclass
@@ -201,7 +204,7 @@ def init_sp_pipeline_state(
     sp_buf = jax.device_put(
         spp.pack_spatial(params_list), NamedSharding(mesh, P())
     )
-    tail_sharding = NamedSharding(mesh, P("stage", None))
+    tail_sharding = NamedSharding(mesh, P(AXIS_STAGE, None))
     tail_buf = jax.device_put(spp.tail_part.pack_params(params_list[spp.spatial_until:]),
                               tail_sharding)
     opt_sp = optimizer.init(sp_buf)
@@ -249,7 +252,7 @@ def _make_sp_step(
     for d in lead_shape:
         groups *= d
     tile_axes = tuple(a for a in (sp.axis_h, sp.axis_w) if a)
-    grad_axes: Tuple[str, ...] = ("data",) if with_data_axis else ()
+    grad_axes: Tuple[str, ...] = (AXIS_DATA,) if with_data_axis else ()
     sp_ctx = ApplyCtx(train=True, spatial=sp)
     tail_ctx = ApplyCtx(train=True)
 
@@ -257,7 +260,7 @@ def _make_sp_step(
     with_stats_tail = bn_stats and part.stat_max > 0
     branches = make_stage_branches(
         part, tail_ctx, compute_dtype, remat, with_stats_tail,
-        vary_axes=("stage",) + tile_axes + grad_axes,
+        vary_axes=(AXIS_STAGE,) + tile_axes + grad_axes,
     )
 
     def phase1(sp_flat, x_tile):
@@ -273,7 +276,7 @@ def _make_sp_step(
                 f"over junction degree {degree} for the batch_split junction; "
                 f"choose batch = {groups} * microbatch with (B/S) % degree == 0"
             )
-        s_idx = lax.axis_index("stage")
+        s_idx = lax.axis_index(AXIS_STAGE)
         xs = lax.dynamic_slice_in_dim(x_tile, s_idx * chunk, chunk, axis=0)
         params_sp = spp.sp_pack.unpack(sp_flat)
 
@@ -305,7 +308,7 @@ def _make_sp_step(
 
         # Line all stage chunks up in batch order on every device.
         def g(t):
-            t = lax.all_gather(t, "stage", axis=0, tiled=True)
+            t = lax.all_gather(t, AXIS_STAGE, axis=0, tiled=True)
             return t.reshape(*lead_shape, spp.mb_tail, *t.shape[1:])
 
         return jax.tree.map(g, act), sp_stats
@@ -327,15 +330,15 @@ def _make_sp_step(
     def sharded_step(sp_buf, tail_row, opt_sp, opt_tail, x, labels):
         tail_flat = tail_row[0]
         y_parts = labels_to_parts(labels)
-        vary_axes = ("stage",) + tile_axes + grad_axes
+        vary_axes = (AXIS_STAGE,) + tile_axes + grad_axes
 
         def loss_and_metrics(sp_flat, tail_flat):
             x_parts, sp_stats = phase1(sp_flat, x)
             loss_acc, acc_acc, tail_stats = scan_fn(
                 branches, tail_flat, x_parts, y_parts, vary_axes
             )
-            loss = lax.psum(loss_acc, "stage") / denom
-            acc = lax.psum(acc_acc, "stage") / denom
+            loss = lax.psum(loss_acc, AXIS_STAGE) / denom
+            acc = lax.psum(acc_acc, AXIS_STAGE) / denom
             if tile_axes:
                 loss = lax.pmean(loss, tile_axes)
                 acc = lax.pmean(acc, tile_axes)
@@ -350,7 +353,7 @@ def _make_sp_step(
 
         # Identity-on-value invariance bookkeeping (derivation in the module
         # docstring: AD already psum'd these cotangents home):
-        g_sp = lax.pmean(g_sp, "stage")
+        g_sp = lax.pmean(g_sp, AXIS_STAGE)
         if tile_axes:
             g_sp = lax.pmean(g_sp, tile_axes)
             g_tail = lax.pmean(g_tail, tile_axes)
@@ -364,7 +367,7 @@ def _make_sp_step(
             # Spatial stats vary over stage (distinct batch chunks) and data;
             # the tile axes are already reduced inside BN (cross-tile psum) or
             # the deposit (per-tile pmean).  sp_buf is fully replicated.
-            st = lax.pmean(sp_stats, ("stage",) + grad_axes)
+            st = lax.pmean(sp_stats, (AXIS_STAGE,) + grad_axes)
             new_sp = new_sp.at[jnp.asarray(spp.sp_stat_idx)].set(
                 st.astype(new_sp.dtype)
             )
@@ -387,8 +390,8 @@ def _make_sp_step(
         )
 
     x_spec = spatial_partition_spec(sp, data=with_data_axis)
-    y_spec = P("data") if with_data_axis else P()
-    tail_spec = P("stage", None)
+    y_spec = P(AXIS_DATA) if with_data_axis else P()
+    tail_spec = P(AXIS_STAGE, None)
     smapped = shard_map(
         sharded_step,
         mesh=mesh,
@@ -471,14 +474,14 @@ def make_sp_gems_train_step(
     mirror_perm = [(i, S - 1 - i) for i in range(S)]
 
     def scan_fn(branches, tail_flat, x_parts, y_parts, vary_axes):
-        mirror_params = lax.ppermute(tail_flat, "stage", mirror_perm)
+        mirror_params = lax.ppermute(tail_flat, AXIS_STAGE, mirror_perm)
         loss_acc, acc_acc, stA, stB = gems_dual_scan(
             part, branches, tail_flat, mirror_params, x_parts, y_parts,
             vary_axes=vary_axes,
             from_probs=from_probs,
             compute_dtype=compute_dtype,
         )
-        st = (stA + lax.ppermute(stB, "stage", mirror_perm)) / (2 * times * parts)
+        st = (stA + lax.ppermute(stB, AXIS_STAGE, mirror_perm)) / (2 * times * parts)
         return loss_acc, acc_acc, st
 
     return _make_sp_step(

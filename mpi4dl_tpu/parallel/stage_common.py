@@ -20,9 +20,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from mpi4dl_tpu.compat import pcast
+
 from mpi4dl_tpu.layer_ctx import ApplyCtx
 from mpi4dl_tpu.parallel.partition import StagePartition, lax_slice, pad_to
 from mpi4dl_tpu.train import accuracy, cross_entropy
+from mpi4dl_tpu.mesh import AXIS_STAGE
 
 
 def make_stage_branches(
@@ -83,7 +86,7 @@ def make_stage_branches(
             else:
                 svec = jnp.zeros((stat_n,), jnp.float32)
                 if vary_axes:
-                    svec = lax.pcast(svec, tuple(vary_axes), to="varying")
+                    svec = pcast(svec, tuple(vary_axes), to="varying")
             return out, svec
 
         return jax.checkpoint(fn) if remat else fn
@@ -118,7 +121,7 @@ def gpipe_scan(
     lead = jax.tree.leaves(x_parts)[0]
     Pn, mb = lead.shape[0], lead.shape[1]
     T = Pn + S - 1
-    s_idx = lax.axis_index("stage")
+    s_idx = lax.axis_index(AXIS_STAGE)
     is_last = s_idx == S - 1
     in_pack0 = part.act_packs[0]
     logits_n = part.out_pack.total
@@ -151,19 +154,19 @@ def gpipe_scan(
         acc_acc = acc_acc + jnp.where(valid, a, 0.0)
         # Hand activations to the next stage (non-wrap: stage 0's stale recv
         # is overwritten by injection next tick).
-        buf = lax.ppermute(y, "stage", [(i, i + 1) for i in range(S - 1)])
+        buf = lax.ppermute(y, AXIS_STAGE, [(i, i + 1) for i in range(S - 1)])
         return (buf, loss_acc, acc_acc, st_acc), None
 
     # Initial carries must be marked varying over the axes the loop makes
     # them vary on, or shard_map's AD produces wrong collective transposes
     # (grads scaled by axis size).
     def v(t):
-        return lax.pcast(t, vary_axes, to="varying")
+        return pcast(t, vary_axes, to="varying")
 
     buf0 = v(jnp.zeros((amax,), compute_dtype))
     st0 = v(jnp.zeros((stat_n,), jnp.float32))
     (_, loss_acc, acc_acc, stats_acc), _ = lax.scan(
-        tick, (buf0, v(jnp.zeros(())), v(jnp.zeros(())), st0), jnp.arange(T)
+        tick, (buf0, v(jnp.zeros((), jnp.float32)), v(jnp.zeros((), jnp.float32)), st0), jnp.arange(T, dtype=jnp.int32)
     )
     return loss_acc, acc_acc, stats_acc
 
@@ -178,7 +181,7 @@ def scatter_stage_stats(part: StagePartition, flat: jax.Array, stats: jax.Array)
     if part.stat_idx is None:
         return flat
     idx_all = jnp.asarray(part.stat_idx)  # [S, stat_max]
-    row = lax.dynamic_index_in_dim(idx_all, lax.axis_index("stage"), keepdims=False)
+    row = lax.dynamic_index_in_dim(idx_all, lax.axis_index(AXIS_STAGE), keepdims=False)
     mask = row >= 0
     safe = jnp.where(mask, row, 0)
     cur = flat[safe]
@@ -225,7 +228,7 @@ def gems_dual_scan(
     lead = jax.tree.leaves(x_groups)[0]
     times, Pn, mb = lead.shape[0], lead.shape[2], lead.shape[3]
     T = Pn + S - 1
-    d = lax.axis_index("stage")
+    d = lax.axis_index(AXIS_STAGE)
     in_pack0 = part.act_packs[0]
     logits_n = part.out_pack.total
     nclass = part.out_pack.shapes[0][-1]
@@ -235,7 +238,7 @@ def gems_dual_scan(
     bwd_perm = [(i + 1, i) for i in range(S - 1)]
 
     def v(t):
-        return lax.pcast(t, vary_axes, to="varying")
+        return pcast(t, vary_axes, to="varying")
 
     def one_pair(carry, pair):
         loss_in, acc_in, stA_in, stB_in = carry
@@ -284,25 +287,25 @@ def gems_dual_scan(
                 + jnp.where(validA, accuracy(logitsA, lblA), 0.0)
                 + jnp.where(validB, accuracy(logitsB, lblB), 0.0)
             )
-            bufA = lax.ppermute(yA, "stage", fwd_perm)
-            bufB = lax.ppermute(yB, "stage", bwd_perm)
+            bufA = lax.ppermute(yA, AXIS_STAGE, fwd_perm)
+            bufB = lax.ppermute(yB, AXIS_STAGE, bwd_perm)
             return (bufA, bufB, l_acc, a_acc, stA, stB), None
 
         init = (
             v(jnp.zeros((amax,), compute_dtype)),
             v(jnp.zeros((amax,), compute_dtype)),
-            v(jnp.zeros(())),
-            v(jnp.zeros(())),
+            v(jnp.zeros((), jnp.float32)),
+            v(jnp.zeros((), jnp.float32)),
             stA_in,
             stB_in,
         )
-        (_, _, l_acc, a_acc, stA, stB), _ = lax.scan(tick, init, jnp.arange(T))
+        (_, _, l_acc, a_acc, stA, stB), _ = lax.scan(tick, init, jnp.arange(T, dtype=jnp.int32))
         return (loss_in + l_acc, acc_in + a_acc, stA, stB), None
 
     st0 = v(jnp.zeros((stat_n,), jnp.float32))
     (loss_acc, acc_acc, stA_acc, stB_acc), _ = lax.scan(
         one_pair,
-        (v(jnp.zeros(())), v(jnp.zeros(())), st0, v(jnp.zeros((stat_n,), jnp.float32))),
+        (v(jnp.zeros((), jnp.float32)), v(jnp.zeros((), jnp.float32)), st0, v(jnp.zeros((stat_n,), jnp.float32))),
         (x_groups, y_groups),
     )
     return loss_acc, acc_acc, stA_acc, stB_acc

@@ -28,8 +28,11 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from mpi4dl_tpu.compat import pcast
+
 from mpi4dl_tpu.cells import CellModel
 from mpi4dl_tpu.layer_ctx import ApplyCtx, SpatialCtx
+from mpi4dl_tpu.mesh import AXIS_DATA
 
 
 def cross_entropy(logits_or_probs: jax.Array, labels: jax.Array,
@@ -307,7 +310,7 @@ def make_train_step(
 
     # DP: batch sharded over 'data'; params replicated.  XLA inserts the
     # gradient all-reduce (the reference's SyncAllreduce, comm.py:440-514).
-    data_spec = NamedSharding(mesh, P("data"))
+    data_spec = NamedSharding(mesh, P(AXIS_DATA))
     repl = NamedSharding(mesh, P())
     jstep = jax.jit(
         step,
@@ -326,7 +329,7 @@ def make_train_step(
 def spatial_partition_spec(sp: SpatialCtx, data: bool = False) -> P:
     """PartitionSpec for an NHWC batch under a SpatialCtx (the analog of the
     reference's split_input slicing, train_spatial.py:241-290)."""
-    return P("data" if data else None, sp.axis_h, sp.axis_w, None)
+    return P(AXIS_DATA if data else None, sp.axis_h, sp.axis_w, None)
 
 
 def make_spatial_train_step(
@@ -365,7 +368,7 @@ def make_spatial_train_step(
         junction_shard_index,
     )
 
-    ctx = ApplyCtx(train=True, spatial=sp, data_axis="data" if with_data_axis else None)
+    ctx = ApplyCtx(train=True, spatial=sp, data_axis=AXIS_DATA if with_data_axis else None)
     sp_last = levels[-1][1] if levels else sp
     degree = local_dp if local_dp else sp_last.grid_h * sp_last.grid_w
 
@@ -386,10 +389,10 @@ def make_spatial_train_step(
         return cross_entropy(logits, labels, from_probs), (logits, labels, stats)
     grad_axes = tuple(a for a in (sp.axis_h, sp.axis_w) if a)
     if with_data_axis:
-        grad_axes = ("data",) + grad_axes
+        grad_axes = (AXIS_DATA,) + grad_axes
 
     x_spec = spatial_partition_spec(sp, data=with_data_axis)
-    y_spec = P("data") if with_data_axis else P()
+    y_spec = P(AXIS_DATA) if with_data_axis else P()
 
     def global_loss_fn(p, xx, yy):
         # pmean over the tile axes makes the differentiated scalar the GLOBAL
@@ -413,7 +416,7 @@ def make_spatial_train_step(
             mb_y = labels.reshape(parts, labels.shape[0] // parts)
             # Mark accumulators varying over the tile axes (see pipeline.py —
             # required for correct collective transposes under shard_map AD).
-            v = lambda t: lax.pcast(t, grad_axes, to="varying")
+            v = lambda t: pcast(t, grad_axes, to="varying")
             zero = jax.tree.map(lambda p: v(jnp.zeros_like(p)), params)
             stats_struct = jax.eval_shape(grads_for, params, mb_x[0], mb_y[0])[2]
             stats_zero = jax.tree.map(
@@ -448,7 +451,7 @@ def make_spatial_train_step(
         }
         return new_params, new_opt, metrics
 
-    from jax import shard_map
+    from mpi4dl_tpu.compat import shard_map
 
     smapped = shard_map(
         sharded_step,
@@ -493,7 +496,7 @@ def make_eval_step(
 
     if mesh is None:
         return jax.jit(estep)
-    data_spec = NamedSharding(mesh, P("data"))
+    data_spec = NamedSharding(mesh, P(AXIS_DATA))
     return jax.jit(estep, in_shardings=(None, data_spec, data_spec))
 
 
@@ -510,7 +513,7 @@ def make_spatial_eval_step(
     local_dp: Optional[int] = None,
 ):
     """SP(+DP) inference step: tiles in, metrics out (train=False)."""
-    from jax import shard_map
+    from mpi4dl_tpu.compat import shard_map
 
     from mpi4dl_tpu.parallel.spatial import (
         apply_spatial_model,
@@ -518,13 +521,13 @@ def make_spatial_eval_step(
     )
 
     ctx = ApplyCtx(
-        train=False, spatial=sp, data_axis="data" if with_data_axis else None
+        train=False, spatial=sp, data_axis=AXIS_DATA if with_data_axis else None
     )
     red_axes = tuple(a for a in (sp.axis_h, sp.axis_w) if a)
     if with_data_axis:
-        red_axes = ("data",) + red_axes
+        red_axes = (AXIS_DATA,) + red_axes
     x_spec = spatial_partition_spec(sp, data=with_data_axis)
-    y_spec = P("data") if with_data_axis else P()
+    y_spec = P(AXIS_DATA) if with_data_axis else P()
     sp_last = levels[-1][1] if levels else sp
     degree = local_dp if local_dp else sp_last.grid_h * sp_last.grid_w
 

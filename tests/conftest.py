@@ -14,8 +14,12 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
 
+from mpi4dl_tpu.compat import ensure_host_device_count  # noqa: E402
+
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+# jax_num_cpu_devices on new jax; XLA_FLAGS fallback on old (the flag is
+# read at backend init, which has not happened yet at conftest time).
+ensure_host_device_count(8)
 jax.config.update("jax_threefry_partitionable", True)
 
 # Persistent compilation cache: the CPU-mesh programs here are compile-bound

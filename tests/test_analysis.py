@@ -1,0 +1,473 @@
+"""Tests for the shard-safety analyzer (mpi4dl_tpu/analysis).
+
+One known-violation fixture (positive) and a clean counterpart (negative)
+per rule family, plus the repo gate: the shipped package must be
+violation-free modulo the checked-in baseline — this is the test that makes
+"a TPU tunnel window is 8 hours away" irrelevant for this bug class.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from mpi4dl_tpu.analysis import (
+    RULES_BY_NAME,
+    analyze_paths,
+    apply_baseline,
+    load_baseline,
+)
+from mpi4dl_tpu.analysis.__main__ import default_paths, repo_root
+
+
+def _run(tmp_path, source, rule=None, filename="mpi4dl_tpu/fix.py"):
+    f = tmp_path / filename
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(textwrap.dedent(source))
+    rules = [RULES_BY_NAME[rule]] if rule else None
+    return analyze_paths([str(f)], root=str(tmp_path), rules=rules)
+
+
+# ---------------------------------------------------------------------------
+# (1) collective-axis
+# ---------------------------------------------------------------------------
+
+
+def test_collective_axis_positive(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        from jax import lax
+        def f(x):
+            return lax.psum(x, "stagee")
+        """,
+        rule="collective-axis",
+    )
+    assert len(vs) == 1 and "stagee" in vs[0].message
+
+
+def test_collective_axis_negative(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+        from mpi4dl_tpu.mesh import AXIS_STAGE
+        def f(x):
+            y = lax.psum(x, AXIS_STAGE)
+            y = lax.pmean(y, ("data", "sph"))
+            spec = P("data", None, ("sph", "spw"))
+            return y, spec
+        """,
+        rule="collective-axis",
+    )
+    assert vs == []
+
+
+def test_partition_spec_positive(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        from jax.sharding import PartitionSpec
+        SPEC = PartitionSpec("datta", None)
+        """,
+        rule="collective-axis",
+    )
+    assert len(vs) == 1 and "datta" in vs[0].message
+
+
+def test_collective_axis_compat_pcast(tmp_path):
+    # pcast routed through the compat shim (how the whole package calls it)
+    # must be axis-checked exactly like lax.pcast
+    vs = _run(
+        tmp_path,
+        """
+        from mpi4dl_tpu.compat import pcast
+        def f(x):
+            return pcast(x, ("bogus_axis",), to="varying")
+        """,
+        rule="collective-axis",
+    )
+    assert len(vs) == 1 and "bogus_axis" in vs[0].message
+
+
+def test_ppermute_bijection_positive(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        from jax import lax
+        def f(x):
+            return lax.ppermute(x, "stage", [(0, 1), (0, 2)])
+        """,
+        rule="collective-axis",
+    )
+    assert len(vs) == 1 and "bijection" in vs[0].message
+
+
+def test_ppermute_bijection_negative(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        from jax import lax
+        def f(x):
+            y = lax.ppermute(x, "stage", [(0, 1), (1, 0)])
+            # dynamic tables are not statically checkable -> no violation
+            return lax.ppermute(y, "stage", [(i, i + 1) for i in range(3)])
+        """,
+        rule="collective-axis",
+    )
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# (2) tracer-leak
+# ---------------------------------------------------------------------------
+
+_LEAKY = """
+    import time
+    import jax
+    import numpy as np
+
+    def inner(x):
+        t = time.time()
+        return float(x.sum()) + t
+
+    def step(x):
+        return inner(x)
+
+    jstep = jax.jit(step)
+"""
+
+
+def test_tracer_leak_positive(tmp_path):
+    vs = _run(tmp_path, _LEAKY, rule="tracer-leak")
+    msgs = "\n".join(v.message for v in vs)
+    assert "time.time" in msgs and "float() host sync" in msgs
+
+
+def test_tracer_leak_negative_unjitted(tmp_path):
+    # identical body, but nothing roots it in a trace -> host syncs are fine
+    vs = _run(
+        tmp_path,
+        """
+        import time
+
+        def inner(x):
+            t = time.time()
+            return float(x.sum()) + t
+
+        def step(x):
+            return inner(x)
+        """,
+        rule="tracer-leak",
+    )
+    assert vs == []
+
+
+def test_tracer_leak_control_flow_and_pragma(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def step(x):
+            if jnp.any(x > 0):
+                x = x + 1
+            y = x.item()  # analysis: ok(tracer-leak)
+            return x, y
+
+        jstep = jax.jit(step)
+        """,
+        rule="tracer-leak",
+    )
+    # the `if` fires; the pragma'd .item() does not
+    assert len(vs) == 1 and "`if` on a jnp value" in vs[0].message
+
+
+def test_tracer_leak_same_named_nested_helpers(tmp_path):
+    # two factories each defining a nested `tick` (this codebase's dominant
+    # naming pattern): the defect in the FIRST factory's tick must be found —
+    # name-keyed collection used to keep only the last definition.
+    vs = _run(
+        tmp_path,
+        """
+        from jax import lax
+
+        def factory_a(xs):
+            def tick(carry, x):
+                return carry + float(x), None
+            return lax.scan(tick, 0.0, xs)
+
+        def factory_b(xs):
+            def tick(carry, x):
+                return carry + x, None
+            return lax.scan(tick, 0.0, xs)
+        """,
+        rule="tracer-leak",
+    )
+    assert len(vs) == 1 and "float() host sync" in vs[0].message
+
+
+def test_tracer_leak_shard_map_root(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        import numpy as np
+        from mpi4dl_tpu.compat import shard_map
+
+        def body(x):
+            return np.asarray(x)
+
+        smapped = shard_map(body, mesh=None, in_specs=(), out_specs=())
+        """,
+        rule="tracer-leak",
+    )
+    assert len(vs) == 1 and "asarray" in vs[0].message
+
+
+# ---------------------------------------------------------------------------
+# (3) dtype-policy
+# ---------------------------------------------------------------------------
+
+
+def test_dtype_policy_positive_hot_path(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+        def f(n):
+            return jnp.zeros((n, n)), jnp.arange(n)
+        """,
+        rule="dtype-policy",
+        filename="mpi4dl_tpu/ops/fix.py",
+    )
+    assert len(vs) == 2
+
+
+def test_dtype_policy_negative_hot_path(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+        def f(n, like):
+            a = jnp.zeros((n, n), jnp.float32)
+            b = jnp.arange(n, dtype=jnp.int32)
+            c = jnp.zeros_like(like)  # inherits dtype: fine
+            return a, b, c
+        """,
+        rule="dtype-policy",
+        filename="mpi4dl_tpu/ops/fix.py",
+    )
+    assert vs == []
+
+
+def test_dtype_policy_float64(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+        def f(x):
+            return x.astype(jnp.float64)
+        """,
+        rule="dtype-policy",
+    )
+    assert len(vs) == 1 and "float64" in vs[0].message
+
+
+def test_dtype_policy_param_init(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        class Layer:
+            def init(self, key, shape):
+                w = jax.random.normal(key, shape, dtype=jnp.bfloat16)
+                b = jnp.zeros((shape[-1],), jnp.float32)
+                return w, b
+        """,
+        rule="dtype-policy",
+    )
+    assert len(vs) == 1 and "bfloat16" in vs[0].message
+
+
+# ---------------------------------------------------------------------------
+# (4) env-hatch
+# ---------------------------------------------------------------------------
+
+
+def test_env_hatch_undeclared_positive(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        import os
+        FLAG = os.environ.get("MPI4DL_NOT_A_REAL_FLAG")
+        """,
+        rule="env-hatch",
+    )
+    assert len(vs) == 1 and "MPI4DL_NOT_A_REAL_FLAG" in vs[0].message
+
+
+def test_env_hatch_declared_negative(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        import os
+        FLAG = os.environ.get("MPI4DL_REMAT_OPS") == "1"
+        """,
+        rule="env-hatch",
+    )
+    assert vs == []
+
+
+def test_env_hatch_dead_flag(tmp_path):
+    # a fixture registry whose hatch nothing reads -> dead flag; adding a
+    # read clears it.  (The fixture config.py shadows the real registry via
+    # the mpi4dl_tpu/config.py suffix match.)
+    registry = """
+        class Hatch:
+            def __init__(self, name, default, doc, internal=False):
+                self.name = name
+        HATCHES = {h.name: h for h in (
+            Hatch("MPI4DL_FIXTURE_FLAG", "0", "unused"),
+        )}
+    """
+    (tmp_path / "mpi4dl_tpu").mkdir(parents=True, exist_ok=True)
+    (tmp_path / "mpi4dl_tpu" / "config.py").write_text(
+        textwrap.dedent(registry)
+    )
+    vs = analyze_paths(
+        [str(tmp_path / "mpi4dl_tpu")],
+        root=str(tmp_path),
+        rules=[RULES_BY_NAME["env-hatch"]],
+    )
+    assert len(vs) == 1 and "never read" in vs[0].message
+
+    (tmp_path / "mpi4dl_tpu" / "user.py").write_text(
+        'import os\nX = os.environ.get("MPI4DL_FIXTURE_FLAG")\n'
+    )
+    vs = analyze_paths(
+        [str(tmp_path / "mpi4dl_tpu")],
+        root=str(tmp_path),
+        rules=[RULES_BY_NAME["env-hatch"]],
+    )
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# (5) retrace
+# ---------------------------------------------------------------------------
+
+
+def test_retrace_module_array_positive(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+        TABLE = jnp.ones((4, 4))
+        """,
+        rule="retrace",
+    )
+    assert len(vs) == 1 and "module-level" in vs[0].message
+
+
+def test_retrace_module_array_negative(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        import numpy as np
+        TABLE = np.ones((4, 4))  # numpy at module level is fine
+        def f():
+            import jax.numpy as jnp
+            return jnp.ones((4, 4))  # inside a function is fine
+        """,
+        rule="retrace",
+    )
+    assert vs == []
+
+
+def test_retrace_static_arg_positive(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        import jax
+        def f(x, cfg=[1, 2]):
+            return x
+        jf = jax.jit(f, static_argnums=1)
+        """,
+        rule="retrace",
+    )
+    assert len(vs) == 1 and "mutable literal" in vs[0].message
+
+
+def test_retrace_static_arg_negative(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        import jax
+        def f(x, cfg=(1, 2)):
+            return x
+        jf = jax.jit(f, static_argnums=1)
+        jg = jax.jit(f, static_argnames="cfg")
+        """,
+        rule="retrace",
+    )
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# repo gate + CLI
+# ---------------------------------------------------------------------------
+
+
+def test_repo_is_violation_free_modulo_baseline():
+    root = repo_root()
+    violations = analyze_paths(default_paths(root), root=root)
+    baseline_path = os.path.join(root, "analysis_baseline.json")
+    if os.path.exists(baseline_path):
+        violations, _stale = apply_baseline(
+            violations, load_baseline(baseline_path)
+        )
+    assert violations == [], "\n".join(v.render() for v in violations)
+
+
+def test_readme_hatch_table_in_sync():
+    """README claims its env-hatch table is generated from config.HATCHES —
+    hold it to that: the exact hatches_markdown() output must appear."""
+    from mpi4dl_tpu.config import hatches_markdown
+
+    with open(os.path.join(repo_root(), "README.md"), encoding="utf-8") as fh:
+        readme = fh.read()
+    assert hatches_markdown() in readme, (
+        "README env-hatch table is out of sync with config.HATCHES; "
+        "regenerate it with `python -m mpi4dl_tpu.analysis --hatch-docs`"
+    )
+
+
+def test_cli_exit_codes(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        'from jax import lax\n\ndef f(x):\n    return lax.psum(x, "nope")\n'
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "mpi4dl_tpu.analysis", "--json", str(bad)],
+        capture_output=True, text=True, env=env, cwd=repo_root(),
+    )
+    assert r.returncode == 1, r.stdout + r.stderr
+    payload = json.loads(r.stdout)
+    assert payload["violations"][0]["rule"] == "collective-axis"
+
+    r = subprocess.run(
+        [sys.executable, "-m", "mpi4dl_tpu.analysis", "--list-rules"],
+        capture_output=True, text=True, env=env, cwd=repo_root(),
+    )
+    assert r.returncode == 0
+    for name in ("collective-axis", "tracer-leak", "dtype-policy",
+                 "env-hatch", "retrace"):
+        assert name in r.stdout

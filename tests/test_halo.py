@@ -13,7 +13,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import lax, shard_map
+from jax import lax
+
+from mpi4dl_tpu.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from mpi4dl_tpu.mesh import MeshSpec, build_mesh

@@ -137,7 +137,7 @@ def test_multilevel_d2_forward_matches_single_level(devices8):
     """D2 fused-halo runs under a coarse (rep>1) level must equal the same
     pad-once computation on the fine grid: both layouts realize identical
     global semantics, so the rep-strided halo exchange is pinned exactly."""
-    from jax import shard_map
+    from mpi4dl_tpu.compat import shard_map
     from jax import lax
     from jax.sharding import PartitionSpec as P
 
@@ -170,7 +170,7 @@ def test_amoeba_cell_d2_rep_layout_matches_fine_grid(devices8):
     """AmoebaCell's cell-level D2 pre-exchange with rep_w=2 on a 4-device
     axis must match the fine-grid (grid_w=4) result — the halo pull must
     stride over replication groups, not adjacent devices."""
-    from jax import shard_map
+    from mpi4dl_tpu.compat import shard_map
     from jax import lax
     from jax.sharding import PartitionSpec as P
 
@@ -333,7 +333,8 @@ def test_multilevel_tuple_state_amoebanet_forward(devices8):
     """AmoebaNet cells carry (x, skip) tuple state; respatial must re-shard
     BOTH tensors at a level transition — gathered two-level forward equals
     the unsharded forward."""
-    from jax import lax, shard_map
+    from jax import lax
+    from mpi4dl_tpu.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from mpi4dl_tpu.layer_ctx import ApplyCtx

@@ -5,7 +5,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from mpi4dl_tpu.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from mpi4dl_tpu.mesh import MeshSpec, build_mesh

@@ -20,7 +20,7 @@ def _data(b=2, t=32, d=16, key=0):
 
 @pytest.mark.parametrize("causal", [False, True])
 def test_seqblock_forward_sharded_matches_replicated(devices8, causal):
-    from jax import shard_map
+    from mpi4dl_tpu.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     n = 4

@@ -1,0 +1,47 @@
+"""Pytest wrappers for the script-style silicon checks.
+
+``tests/flash_ring_check.py`` and ``tests/hstripe_check.py`` were written as
+standalone scripts for live-chip validation (VERDICT r4/r5) and were rotting
+outside the suite — nothing ran them, so refactors could silently break the
+exact code paths they pin.  These wrappers run their *host-runnable* modes
+(interpret-mode flash kernel; quick-shape striped paths) under
+``@pytest.mark.slow`` so `pytest -m slow` exercises them anywhere and the
+scripts stay importable/correct; the chip modes remain available by running
+the scripts directly on TPU.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+
+def test_flash_ring_interpret():
+    """Emulated ring schedule with traced per-hop offsets, interpret-mode
+    kernel, vs the full-attention einsum reference."""
+    from flash_ring_check import run_check
+
+    run_check(interpret=True)
+
+
+def test_hstripe_conv_small(monkeypatch):
+    """hstripe_conv2d vs lax.conv at quick shapes with the dispatch gates
+    lowered so a multi-stripe schedule engages (the --small script mode)."""
+    from mpi4dl_tpu.ops import hstripe_conv as HS
+    from hstripe_check import check_conv
+
+    monkeypatch.setattr(HS, "_PATCH_BUDGET", 1024 * 1024)
+    err = check_conv(256, 256, 16)
+    assert err <= 0.02, f"hstripe_conv2d maxerr {err:.3e}"
+
+
+def test_hstripe_layer_run_small(monkeypatch):
+    """hstripe_layer_run vs its pad-once emulation at quick shapes."""
+    from mpi4dl_tpu.ops import hstripe_conv as HS
+    from hstripe_check import check_layer_run
+
+    monkeypatch.setattr(HS, "_RUN_MIN_PIXELS", 1)
+    monkeypatch.setattr(HS, "_RUN_STRIPE_BUDGET", 64 * 1024)
+    err = check_layer_run(256, 256, 16)
+    assert err <= 0.25, f"hstripe_layer_run maxerr {err:.3e}"
