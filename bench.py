@@ -48,18 +48,6 @@ BASELINE_1024_BS2 = 2.95  # reference: AmoebaNet-D 1024² bs2, SP square + D2, 5
 BASELINE_RESNET_1024 = 2.55  # reference: ResNet-110-v2 1024² bs1, SP best, 5 GPUs
 BASELINE_RESNET_2048 = 0.99  # reference: ResNet-110-v2 2048² bs1, SP, 5 GPUs
 
-# bf16 peak FLOP/s by TPU generation (public numbers); matched by substring of
-# jax.devices()[0].device_kind.  Used only for the mfu sanity check.
-_PEAKS = [
-    ("v6", 918e12),
-    ("v5p", 459e12),
-    ("v5 lite", 197e12), ("v5e", 197e12), ("v5litepod", 197e12),
-    ("v5", 459e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 46e12),
-]
-
 # (name, platform, image_size, num_layers, num_filters, warmup, iters,
 #  timeout_s, comparable, remat, batch, scan)
 # The 1024² headline fits WITHOUT remat on a 16 GB chip and runs ~21%
@@ -143,17 +131,15 @@ def _load_measured() -> dict | None:
 
 
 def _peak_flops(device) -> float | None:
-    kind = getattr(device, "device_kind", "") or ""
-    k = kind.lower()
-    if device.platform == "cpu":
-        return None  # no defensible peak for the host CPU; skip mfu
-    for sub, peak in _PEAKS:
-        if sub in k:
-            return peak
-    # Unknown kind: assume the FASTEST known peak.  The mfu>1 check declares a
-    # measurement impossible, so the fallback must over- not under-estimate
-    # the chip (a low assumed peak would fail valid runs on faster chips).
-    return max(p for _, p in _PEAKS)
+    """bf16 peak FLOP/s for the mfu sanity check — the table and matching
+    policy (cpu -> None, substring table, assume-FASTEST for unknown kinds
+    so mfu>1 stays a sound impossibility test) live in the obs subsystem.
+    Imported lazily: the orchestrator process must stay stdlib-only so a
+    broken install still prints its one JSON line."""
+    from mpi4dl_tpu.obs.costs import peak_flops
+
+    peak, _source = peak_flops(device)
+    return peak
 
 
 def _build_step(image_size: int, num_layers: int, num_filters: int,
@@ -381,6 +367,27 @@ def _inner(platform: str, image_size: int, num_layers: int, num_filters: int,
     }
     if error:
         out["error"] = error
+    tdir = os.environ.get("BENCH_TELEMETRY_DIR")
+    if tdir:
+        # --telemetry-dir: mirror the rung result into a RunLog so bench
+        # evidence and training-loop telemetry share one format/reader.
+        try:
+            from mpi4dl_tpu.obs import RunLog
+
+            with RunLog.create(tdir, prefix=f"bench-{model_tag}") as rl:
+                rl.write_meta(
+                    config={
+                        "image_size": image_size, "num_layers": num_layers,
+                        "num_filters": num_filters, "batch": batch,
+                        "remat": remat, "scan": scan, "arch": arch,
+                        "platform": platform,
+                    },
+                    family="bench",
+                )
+                rl.write("summary", **out)
+            print(f"[bench] telemetry -> {rl.path}", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 — telemetry must not kill bench
+            print(f"[bench] telemetry failed: {e}", file=sys.stderr)
     print(json.dumps(out))
 
 
@@ -708,6 +715,17 @@ class _TpuHealth:
 
 
 def main() -> int:
+    # --telemetry-dir DIR: rung subprocesses mirror their JSON result into
+    # RunLog files there (env-carried so the positional --inner protocol is
+    # untouched; _run_sub's env inherits it).
+    if "--telemetry-dir" in sys.argv:
+        i = sys.argv.index("--telemetry-dir")
+        try:
+            os.environ["BENCH_TELEMETRY_DIR"] = sys.argv[i + 1]
+        except IndexError:
+            print("[bench] --telemetry-dir needs a directory", file=sys.stderr)
+            return 2
+        del sys.argv[i:i + 2]
     if len(sys.argv) > 1 and sys.argv[1] == "--inner":
         platform, image_size, num_layers, num_filters, warmup, iters, comp = sys.argv[2:9]
         remat = sys.argv[9] if len(sys.argv) > 9 else "cell"

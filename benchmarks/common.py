@@ -32,10 +32,13 @@ first backend initialization, so the runner applies it just in time).
 
 from __future__ import annotations
 
+import contextlib
 import os
 import queue
 import sys
 import threading
+
+_NULL_CTX = contextlib.nullcontext()
 
 # Make `mpi4dl_tpu` importable when a benchmark script is run by path.
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -263,25 +266,109 @@ def _ensure_devices(need: int) -> None:
 
 def _batches(dataset, batch_size: int, steps: int, num_workers: int):
     """Host batch iterator; num_workers>0 prefetches on a background thread
-    (the reference's DataLoader num_workers analog)."""
+    (the reference's DataLoader num_workers analog).
+
+    Early consumer exit (exception mid-epoch, generator close) must not
+    strand the producer: a plain ``q.put`` on a full queue would block
+    forever holding batch memory once nobody drains it.  The producer
+    therefore puts with a timeout while polling a stop event, and the
+    generator's ``finally`` sets the event and drains the queue so the
+    thread always terminates.  A producer-side exception (dataset I/O)
+    rides the queue as a sentinel and re-raises in the consumer — a dead
+    producer must not leave the consumer blocked on ``q.get()``."""
     if num_workers <= 0:
         for i in range(steps):
             yield dataset.batch(i, batch_size)
         return
     q: queue.Queue = queue.Queue(maxsize=max(2, num_workers))
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def producer():
-        for i in range(steps):
-            q.put(dataset.batch(i, batch_size))
-        q.put(None)
+        try:
+            for i in range(steps):
+                if stop.is_set() or not _put(dataset.batch(i, batch_size)):
+                    return
+        except BaseException as e:  # noqa: BLE001 — forwarded to consumer
+            _put(e)
+            return
+        _put(None)  # end-of-epoch sentinel
 
     t = threading.Thread(target=producer, daemon=True)
     t.start()
-    while True:
-        item = q.get()
-        if item is None:
-            return
-        yield item
+    try:
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+        while True:  # unblock a producer waiting on a full queue
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+        t.join(timeout=5.0)
+
+
+def _open_telemetry(directory, family, cfg, spec, step, state, dataset,
+                    global_batch, argv):
+    """Open a RunLog and write the meta + compiled-step cost records.
+
+    The cost record lowers and compiles the step once more through the AOT
+    path (``step.lower(...).compile()``) to reach ``cost_analysis()`` and
+    the collective-bearing HLO text — an extra compile the flag opts into
+    (the persistent compilation cache absorbs it where enabled).  Failures
+    degrade to a ``cost_error`` record: telemetry must never kill a run."""
+    from mpi4dl_tpu.obs import RunLog
+
+    runlog = RunLog.create(directory, prefix=f"{family}-{cfg.model}")
+    runlog.write_meta(
+        config=cfg, mesh_spec=spec, family=family,
+        argv=list(argv) if argv is not None else sys.argv[1:],
+    )
+    try:
+        import jax
+
+        from mpi4dl_tpu.obs import (
+            arithmetic_intensity, compiled_cost, hlo_collective_stats,
+            peak_flops,
+        )
+
+        x, y = dataset.batch(0, global_batch)
+        compiled = step.lower(state, x, y).compile()
+        cost = compiled_cost(compiled)
+        coll = hlo_collective_stats(compiled.as_text())
+        # Cost-model flops are PER DEVICE (the one SPMD module every device
+        # executes), so the report's MFU divides by one device's peak.
+        peak, src = peak_flops(jax.devices()[0], allow_cpu_nominal=True)
+        runlog.write(
+            "cost",
+            flops=cost["flops"],
+            bytes_accessed=cost["bytes_accessed"],
+            arithmetic_intensity=arithmetic_intensity(
+                cost["flops"], cost["bytes_accessed"]
+            ),
+            collectives=coll,
+            peak_flops=peak,
+            peak_source=src,
+            device_count=len(jax.devices()),
+        )
+    except Exception as e:  # noqa: BLE001 — telemetry must never kill a run
+        runlog.write("cost_error", error=repr(e))
+        print(f"note: telemetry cost analysis unavailable ({e})")
+    return runlog
 
 
 def run(family: str, model: str, argv=None) -> dict:
@@ -297,6 +384,13 @@ def run(family: str, model: str, argv=None) -> dict:
         help="write a jax.profiler trace of the epoch loop (TensorBoard/XProf"
              " format) — the TPU analog of the reference's CUDA-event phase "
              "timing (benchmark_resnet_gems_master_with_sp.py:417-440)",
+    )
+    parser.add_argument(
+        "--telemetry-dir", default=None,
+        help="write a RunLog JSONL (run metadata + per-step records + "
+             "compiled-step cost/collective accounting) under this "
+             "directory; render with `python -m mpi4dl_tpu.obs report` "
+             "(docs/observability.md)",
     )
     args = parser.parse_args(argv)
     cfg = config_from_args(args)
@@ -347,39 +441,70 @@ def run(family: str, model: str, argv=None) -> dict:
 
     dataset = make_dataset(cfg)
     steps = args.steps_per_epoch
-    meter = StepMeter(global_batch)
+    # warmup_steps=1: the first step pays compilation; StepMeter drops it
+    # explicitly (and reports the drop count) instead of the old implicit
+    # `epoch > 0 or i > 0` skip.
+    meter = StepMeter(global_batch, warmup_steps=1)
     timer = Timer()
     metrics = {}
+
+    runlog = None
+    if args.telemetry_dir:
+        runlog = _open_telemetry(
+            args.telemetry_dir, family, cfg, spec, step, state, dataset,
+            global_batch, argv,
+        )
+
     # try/finally: a crash mid-epoch must still flush the profiler trace
     # (start_trace only buffers; stop_trace writes the files — the crash you
-    # wanted to profile would otherwise leave an empty trace dir).
+    # wanted to profile would otherwise leave an empty trace dir) and close
+    # the telemetry sink.
     if args.profile_dir:
         jax.profiler.start_trace(args.profile_dir)
     try:
+        from mpi4dl_tpu.obs import step_annotation
+
+        gstep = 0
         for epoch in range(cfg.num_epochs):
             for i, (x, y) in enumerate(
                 _batches(dataset, global_batch, steps, cfg.num_workers)
             ):
                 timer.start()
-                state, metrics = step(state, x, y)
-                loss = float(metrics["loss"])  # blocks until the step finishes
+                with step_annotation(gstep) if args.profile_dir else (
+                    _NULL_CTX
+                ):
+                    state, metrics = step(state, x, y)
+                    loss = float(metrics["loss"])  # blocks until step finishes
                 ms = timer.stop()
-                if epoch > 0 or i > 0:  # skip compile step in the meter
-                    meter.add(ms)
+                measured = meter.add(ms)
                 print(
                     f"epoch {epoch} step {i} time_ms {ms:.1f} "
                     f"images_per_sec {global_batch / (ms / 1e3):.3f} "
                     f"loss {loss:.4f} acc {float(metrics['accuracy']):.4f}"
                 )
+                if runlog is not None:
+                    runlog.write_step(
+                        epoch=epoch, step=i, ms=ms,
+                        images_per_sec=global_batch / (ms / 1e3),
+                        loss=loss, accuracy=float(metrics["accuracy"]),
+                        step_fn=step, measured=measured,
+                    )
+                gstep += 1
             if ckpt_mgr is not None:
                 ckpt_mgr.save(state, step_id=(epoch + 1) * steps)
     finally:
         if args.profile_dir:
             jax.profiler.stop_trace()
             print(f"profile trace written to {args.profile_dir}")
+        if runlog is not None:
+            runlog.write("summary", **meter.stats())
+            runlog.close()
+            print(f"telemetry written to {runlog.path} "
+                  f"(render: python -m mpi4dl_tpu.obs report {runlog.path})")
     print(meter.summary())
     return {
         "images_per_sec": meter.images_per_sec(),
         "loss": float(metrics["loss"]) if metrics else float("nan"),
         "steps": len(meter.times_ms),
+        "telemetry_path": runlog.path if runlog is not None else None,
     }

@@ -23,7 +23,6 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import re
 import sys
 
 sys.path.insert(
@@ -31,75 +30,10 @@ sys.path.insert(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
 )
 
-_COLLECTIVES = (
-    "collective-permute", "all-reduce", "all-gather", "reduce-scatter",
-    "all-to-all",
-)
-
-_DTYPE_BYTES = {
-    "f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4, "pred": 1,
-    "s8": 1, "u8": 1, "f64": 8, "s64": 8, "u64": 8,
-}
-
-
-def _tensor_bytes(shape_str: str) -> int:
-    """bytes of one HLO shape literal like 'bf16[2,16,16,8]{...}'."""
-    m = re.match(r"(\w+)\[([\d,]*)\]", shape_str)
-    if not m:
-        return 0
-    dt, dims = m.groups()
-    n = 1
-    for d in dims.split(","):
-        if d:
-            n *= int(d)
-    return n * _DTYPE_BYTES.get(dt, 4)
-
-
-def hlo_collective_stats(hlo_text: str) -> dict:
-    """Count collectives + bytes moved per class from compiled HLO text.
-
-    Counts each op once with its OUTPUT shape (for permutes/all-gathers the
-    received bytes; start/done pairs are deduplicated by counting only the
-    -start form when present)."""
-    stats = {k: {"count": 0, "bytes": 0} for k in _COLLECTIVES}
-    for line in hlo_text.splitlines():
-        s = line.strip()
-        m = re.match(
-            r"(?:ROOT\s+)?\S+\s*=\s*((?:\([^)]*\))|(?:\w+\[[^\]]*\]\S*))\s*"
-            r"(collective-permute|all-reduce|all-gather|reduce-scatter|"
-            r"all-to-all)(-start|-done)?\(", s)
-        if not m:
-            continue
-        shape_str, kind, phase = m.groups()
-        if phase == "-done":
-            continue  # counted at -start
-        if shape_str.startswith("("):
-            # Array entries of the tuple (split(',') would break multi-dim
-            # shapes like bf16[2,16,16,8]).
-            parts = re.findall(r"\w+\[[\d,]*\]", shape_str)
-            if phase == "-start":
-                # Async start tuples are (operand, result[, contexts]) —
-                # one transfer; count the RESULT so async and sync forms of
-                # the same program report identical bytes (all-gather's
-                # result carries the group factor, reduce-scatter's the
-                # scattered shard — both matching their sync outputs).
-                nbytes = (
-                    _tensor_bytes(parts[1]) if len(parts) > 1
-                    else (_tensor_bytes(parts[0]) if parts else 0)
-                )
-            else:
-                nbytes = sum(_tensor_bytes(t) for t in parts)
-        else:
-            nbytes = _tensor_bytes(shape_str)
-        stats[kind]["count"] += 1
-        stats[kind]["bytes"] += nbytes
-    stats["total_bytes"] = sum(
-        v["bytes"] for k, v in stats.items() if isinstance(v, dict)
-    )
-    stats["total_count"] = sum(
-        v["count"] for k, v in stats.items() if isinstance(v, dict)
-    )
-    return stats
+# The parsing lives in the obs library now (ISSUE 2: collective accounting
+# as a reusable capability, not a script); re-exported here so existing
+# imports of this tool keep working.
+from mpi4dl_tpu.obs.hlo_stats import hlo_collective_stats  # noqa: E402
 
 
 def main(argv=None) -> int:

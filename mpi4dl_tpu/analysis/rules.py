@@ -9,6 +9,7 @@ from mpi4dl_tpu.analysis.core import Rule
 from mpi4dl_tpu.analysis.rules_collective import RULE as _collective
 from mpi4dl_tpu.analysis.rules_dtype import RULE as _dtype
 from mpi4dl_tpu.analysis.rules_env import RULE as _env
+from mpi4dl_tpu.analysis.rules_print import RULE as _print
 from mpi4dl_tpu.analysis.rules_retrace import RULE as _retrace
 from mpi4dl_tpu.analysis.rules_tracer import RULE as _tracer
 
@@ -18,6 +19,7 @@ RULE_TABLE: List[Rule] = [
     _dtype,
     _env,
     _retrace,
+    _print,
 ]
 
 RULES_BY_NAME: Dict[str, Rule] = {r.name: r for r in RULE_TABLE}

@@ -1,0 +1,59 @@
+"""Rule ``print-call`` (rule 7): no bare ``print()`` in library modules.
+
+Library observability goes through the obs subsystem (``mpi4dl_tpu/obs``:
+RunLog records, trace scopes) or stdlib ``logging`` — a ``print`` in library
+code is output nobody can route, filter, or parse, which is exactly the
+scattered-``print`` observability ISSUE 2 replaces.
+
+Scope: files under ``mpi4dl_tpu/`` only.  Exempt:
+
+- benchmarks/tests/harness files (not package files — out of scope by
+  construction);
+- ``__main__.py`` modules: CLI entry points whose *product* is stdout
+  (``python -m mpi4dl_tpu.analysis``, ``python -m mpi4dl_tpu.obs``);
+- lines/functions carrying the standard ``# analysis: ok(print-call)``
+  pragma (applied by the shared runner).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from mpi4dl_tpu.analysis.core import Project, Rule, Violation
+
+
+class PrintCallRule(Rule):
+    name = "print-call"
+    description = (
+        "bare print() in mpi4dl_tpu/ library modules — emit via obs "
+        "(RunLog/logging) instead; __main__.py CLIs and benchmarks exempt."
+    )
+
+    def check(self, project: Project) -> List[Violation]:
+        out: List[Violation] = []
+        for src in project.package_files():
+            if src.rel.endswith("__main__.py"):
+                continue
+            for node in ast.walk(src.tree):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "print"
+                    # a locally-bound `print` (alias/param) is not builtin
+                    and src.aliases.get("print", "print") == "print"
+                ):
+                    out.append(
+                        Violation(
+                            self.name,
+                            src.rel,
+                            node.lineno,
+                            "bare print() in library module — route output "
+                            "through mpi4dl_tpu.obs (RunLog) or logging "
+                            "(benchmarks and __main__ CLIs are exempt)",
+                        )
+                    )
+        return out
+
+
+RULE = PrintCallRule()

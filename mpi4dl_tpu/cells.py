@@ -23,6 +23,7 @@ import jax.numpy as jnp
 
 from mpi4dl_tpu.layer_ctx import ApplyCtx, EVAL_CTX
 from mpi4dl_tpu.layers import Layer
+from mpi4dl_tpu.obs.scopes import scope
 
 Act = Union[jax.Array, Tuple[jax.Array, ...]]
 ShapeLike = Union[Tuple[int, ...], Tuple[Tuple[int, ...], ...]]
@@ -152,10 +153,11 @@ class CellModel:
                 def grp_fn(ps, x, c, _grp=grp):
                     m = None
                     for k, i in enumerate(_grp):
-                        x, m = checkpointed_apply(
-                            self.cells[i].apply, ps[k], x, c,
-                            in_meta=m, pack=True,
-                        )
+                        with scope(f"cell{i:02d}"):
+                            x, m = checkpointed_apply(
+                                self.cells[i].apply, ps[k], x, c,
+                                in_meta=m, pack=True,
+                            )
                     return _unpack_act(x, m)
 
                 x, meta = checkpointed_apply(
@@ -165,13 +167,14 @@ class CellModel:
             return _unpack_act(x, meta)
         meta = None
         for i in range(start, stop):
-            if remat:
-                x, meta = checkpointed_apply(
-                    self.cells[i].apply, params_list[i], x, ctx,
-                    in_meta=meta, pack=True,
-                )
-            else:
-                x = self.cells[i].apply(params_list[i], x, ctx)
+            with scope(f"cell{i:02d}"):
+                if remat:
+                    x, meta = checkpointed_apply(
+                        self.cells[i].apply, params_list[i], x, ctx,
+                        in_meta=meta, pack=True,
+                    )
+                else:
+                    x = self.cells[i].apply(params_list[i], x, ctx)
         return _unpack_act(x, meta) if remat else x
 
     def out_shapes(self, params_list) -> List[ShapeLike]:

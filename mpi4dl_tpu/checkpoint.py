@@ -109,5 +109,7 @@ class CheckpointManager:
         path = self.latest_path()
         if path is None:
             return template
-        print(f"restoring checkpoint {path}")
+        import logging
+
+        logging.getLogger(__name__).info("restoring checkpoint %s", path)
         return restore_state(path, template)

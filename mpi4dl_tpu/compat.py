@@ -38,13 +38,13 @@ except ImportError:  # jax 0.4.x
     from jax.experimental.shard_map import shard_map as _shard_map
 
     _LEGACY = True
-    import sys as _sys
+    import warnings as _warnings
 
-    print(
+    _warnings.warn(
         "mpi4dl_tpu.compat: legacy jax (<jax.shard_map) — vma varying-marks "
         "are no-ops; pipeline/GEMS gradient exactness is not guaranteed on "
         "this jax version (see mpi4dl_tpu/compat.py)",
-        file=_sys.stderr,
+        stacklevel=2,
     )
 
 

@@ -67,6 +67,10 @@ HATCHES: Dict[str, Hatch] = {
               "1 = route eligible spatial convs through the Pallas "
               "implicit-GEMM kernel in bench.py A/Bs (off: XLA wins at the "
               "step level — PERF_NOTES r4)."),
+        Hatch("MPI4DL_NO_SCOPES", "0",
+              "1 = disable obs trace scopes (jax.named_scope semantic names "
+              "in traces/HLO) and host step annotations — pristine A/B "
+              "compiles."),
         Hatch("MPI4DL_TPU_TESTS", "0",
               "1 = opt in to real-TPU subprocess tests (the tunnel is slow "
               "and intermittently down)."),

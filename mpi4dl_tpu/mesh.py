@@ -118,7 +118,6 @@ def initialize_distributed(
     second call is a no-op.
     """
     import os
-    import sys
 
     import jax
 
@@ -156,5 +155,9 @@ def initialize_distributed(
         except (RuntimeError, ValueError) as e:
             if configured:
                 raise
-            print(f"note: single-process mode ({e})", file=sys.stderr)
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "single-process mode (%s)", e
+            )
     return jax.process_index()

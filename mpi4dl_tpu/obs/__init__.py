@@ -1,0 +1,68 @@
+"""Structured telemetry: the library that replaces print-pile observability.
+
+Every training step becomes self-describing through four pieces (ISSUE 2;
+the reference's CUDA-event phase timing + MPI message accounting, SURVEY
+§2a, re-expressed as compiler artifacts):
+
+- **Trace scopes** (:mod:`~mpi4dl_tpu.obs.scopes`): ``obs.scope(name)``
+  threads semantic names (``cell03``, ``halo_exchange_w``, ``stage1``)
+  through the hot paths so XProf traces and compiled HLO carry phase
+  attribution.  Disable with ``MPI4DL_NO_SCOPES=1``.
+- **Run telemetry** (:mod:`~mpi4dl_tpu.obs.runlog`): :class:`RunLog` JSONL
+  sink — run metadata (config, mesh, device, jax version, active hatches)
+  plus per-step records (wall ms, images/sec, loss/acc, memory watermark,
+  jit-cache retrace probe).
+- **Derived metrics** (:mod:`~mpi4dl_tpu.obs.costs`,
+  :mod:`~mpi4dl_tpu.obs.hlo_stats`): FLOPs/bytes from
+  ``compiled.cost_analysis()`` → MFU + arithmetic intensity; per-class
+  collective count/bytes parsed from compiled HLO.
+- **Surfaces**: ``python -m mpi4dl_tpu.obs report run.jsonl``
+  (:mod:`~mpi4dl_tpu.obs.report`), and ``--telemetry-dir`` on every
+  benchmark entry point (benchmarks/common.py) and bench.py.
+"""
+
+from __future__ import annotations
+
+from mpi4dl_tpu.obs.scopes import scope, scopes_enabled, step_annotation
+from mpi4dl_tpu.obs.runlog import (
+    RunLog,
+    active_hatches,
+    device_memory_watermark,
+    host_rss_peak_bytes,
+    jit_cache_size,
+    read_runlog,
+)
+from mpi4dl_tpu.obs.costs import (
+    arithmetic_intensity,
+    compiled_cost,
+    mfu,
+    peak_flops,
+    step_cost,
+)
+from mpi4dl_tpu.obs.hlo_stats import (
+    compiled_collective_stats,
+    hlo_collective_stats,
+    scope_names,
+    stablehlo_debug_text,
+)
+
+__all__ = [
+    "RunLog",
+    "active_hatches",
+    "arithmetic_intensity",
+    "compiled_collective_stats",
+    "compiled_cost",
+    "device_memory_watermark",
+    "hlo_collective_stats",
+    "host_rss_peak_bytes",
+    "jit_cache_size",
+    "mfu",
+    "peak_flops",
+    "read_runlog",
+    "scope",
+    "scope_names",
+    "scopes_enabled",
+    "stablehlo_debug_text",
+    "step_annotation",
+    "step_cost",
+]

@@ -1,0 +1,42 @@
+"""CLI: ``python -m mpi4dl_tpu.obs report run.jsonl [more.jsonl ...]``.
+
+Renders the summary table of one or more RunLog files (docs/observability.md
+documents every field).  Exit status: 0 on success, 2 on usage errors or
+unreadable files.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m mpi4dl_tpu.obs",
+        description="Telemetry surfaces (see docs/observability.md).",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    rep = sub.add_parser("report", help="render RunLog JSONL file(s)")
+    rep.add_argument("paths", nargs="+", help="run .jsonl file(s)")
+    args = ap.parse_args(argv)
+
+    if args.cmd == "report":
+        from mpi4dl_tpu.obs.report import render_run
+
+        for i, path in enumerate(args.paths):
+            try:
+                text = render_run(path)
+            except OSError as e:
+                print(f"obs report: cannot read {path}: {e}", file=sys.stderr)
+                return 2
+            if i:
+                print()
+            print(text)
+        return 0
+    return 2  # pragma: no cover — argparse enforces the subcommand
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,122 @@
+"""Collective accounting from compiled HLO — the reusable library form of
+``benchmarks/communication/comm_volume_report.py`` (which now imports from
+here).
+
+Any jitted step can report, at runtime and on any host, how many collectives
+XLA actually scheduled per step and the bytes each class moves — the
+compiler-derived counterpart of the reference's MPI message accounting
+(SURVEY §2a): collective-permute (halo exchange, pipeline handoffs, GEMS
+mirror), all-reduce (DP gradients, cross-tile BN), all-gather /
+reduce-scatter / all-to-all (junctions, GSPMD resharding).
+
+Also home to :func:`stablehlo_debug_text`, the scope-name view of a lowered
+(not yet compiled) program: StableHLO printed with debug locations carries
+the ``jax.named_scope`` stack (``loc("jit(step)/.../cell03/halo_exchange_w/
+ppermute")``), which is how tests assert the obs scopes survive lowering
+without paying for a compile.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict
+
+COLLECTIVE_CLASSES = (
+    "collective-permute", "all-reduce", "all-gather", "reduce-scatter",
+    "all-to-all",
+)
+
+_DTYPE_BYTES = {
+    "f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4, "pred": 1,
+    "s8": 1, "u8": 1, "f64": 8, "s64": 8, "u64": 8,
+}
+
+
+def _tensor_bytes(shape_str: str) -> int:
+    """bytes of one HLO shape literal like 'bf16[2,16,16,8]{...}'."""
+    m = re.match(r"(\w+)\[([\d,]*)\]", shape_str)
+    if not m:
+        return 0
+    dt, dims = m.groups()
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n * _DTYPE_BYTES.get(dt, 4)
+
+
+def hlo_collective_stats(hlo_text: str) -> dict:
+    """Count collectives + bytes moved per class from compiled HLO text.
+
+    Counts each op once with its OUTPUT shape (for permutes/all-gathers the
+    received bytes; start/done pairs are deduplicated by counting only the
+    -start form when present)."""
+    stats = {k: {"count": 0, "bytes": 0} for k in COLLECTIVE_CLASSES}
+    for line in hlo_text.splitlines():
+        s = line.strip()
+        m = re.match(
+            r"(?:ROOT\s+)?\S+\s*=\s*((?:\([^)]*\))|(?:\w+\[[^\]]*\]\S*))\s*"
+            r"(collective-permute|all-reduce|all-gather|reduce-scatter|"
+            r"all-to-all)(-start|-done)?\(", s)
+        if not m:
+            continue
+        shape_str, kind, phase = m.groups()
+        if phase == "-done":
+            continue  # counted at -start
+        if shape_str.startswith("("):
+            # Array entries of the tuple (split(',') would break multi-dim
+            # shapes like bf16[2,16,16,8]).
+            parts = re.findall(r"\w+\[[\d,]*\]", shape_str)
+            if phase == "-start":
+                # Async start tuples are (operand, result[, contexts]) —
+                # one transfer; count the RESULT so async and sync forms of
+                # the same program report identical bytes (all-gather's
+                # result carries the group factor, reduce-scatter's the
+                # scattered shard — both matching their sync outputs).
+                nbytes = (
+                    _tensor_bytes(parts[1]) if len(parts) > 1
+                    else (_tensor_bytes(parts[0]) if parts else 0)
+                )
+            else:
+                nbytes = sum(_tensor_bytes(t) for t in parts)
+        else:
+            nbytes = _tensor_bytes(shape_str)
+        stats[kind]["count"] += 1
+        stats[kind]["bytes"] += nbytes
+    stats["total_bytes"] = sum(
+        v["bytes"] for k, v in stats.items() if isinstance(v, dict)
+    )
+    stats["total_count"] = sum(
+        v["count"] for k, v in stats.items() if isinstance(v, dict)
+    )
+    return stats
+
+
+def compiled_collective_stats(compiled) -> dict:
+    """:func:`hlo_collective_stats` of a jax.stages.Compiled."""
+    return hlo_collective_stats(compiled.as_text())
+
+
+def stablehlo_debug_text(lowered) -> str:
+    """StableHLO asm WITH debug locations for a jax.stages.Lowered — the
+    cheapest artifact in which ``obs.scope`` names are visible (no compile).
+    Falls back to the compiled HLO's op_name metadata if the MLIR handle
+    does not expose debug printing on this jax version."""
+    try:
+        mod = lowered.compiler_ir("stablehlo")
+        return mod.operation.get_asm(enable_debug_info=True)
+    except Exception:  # noqa: BLE001 — jaxlib API drift
+        return lowered.compile().as_text()
+
+
+def scope_names(debug_text: str) -> Dict[str, int]:
+    """Histogram of named-scope path components found in a debug-located
+    StableHLO / metadata-bearing HLO text.  Component = one level of the
+    ``a/b/c`` op-name path, with jit/shard_map framing stripped."""
+    out: Dict[str, int] = {}
+    for m in re.finditer(r'"((?:jit|shmap)[^"]*)"', debug_text):
+        for comp in m.group(1).split("/"):
+            if comp.startswith(("jit(", "shmap", "transpose(", "vmap(")):
+                continue
+            out[comp] = out.get(comp, 0) + 1
+    return out

@@ -1,0 +1,163 @@
+"""Render a RunLog JSONL file as a human-readable summary.
+
+Pure string construction (printing happens in obs/__main__.py — the CLI
+surface; library modules never print, analysis rule ``print-call``).  The
+summary is computed from the step records themselves, so it works on files
+from crashed runs that never wrote a summary record.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from mpi4dl_tpu.obs.costs import mfu
+from mpi4dl_tpu.obs.hlo_stats import COLLECTIVE_CLASSES
+from mpi4dl_tpu.obs.runlog import read_runlog
+# Same interpolation as StepMeter.stats(), so report percentiles of the raw
+# step records always match a run's own summary record.
+from mpi4dl_tpu.utils.misc import _percentile as _pct
+
+
+def _fmt_bytes(n: Optional[float]) -> str:
+    if n is None:
+        return "n/a"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+    return f"{n:.1f} TiB"
+
+
+def _first(records: List[dict], kind: str) -> Optional[dict]:
+    for r in records:
+        if r.get("kind") == kind:
+            return r
+    return None
+
+
+def render_run(path: str) -> str:
+    """The report for one run file."""
+    records = read_runlog(path)
+    lines: List[str] = [f"== {path}"]
+    meta = _first(records, "meta")
+    cost = _first(records, "cost")
+    steps = [r for r in records if r.get("kind") == "step"]
+    measured = [r for r in steps if r.get("measured", True)]
+    warmup = len(steps) - len(measured)
+
+    if meta is not None:
+        cfg = meta.get("config") or {}
+        desc = " ".join(
+            f"{k}={cfg[k]}" for k in (
+                "model", "image_size", "batch_size", "split_size",
+                "spatial_size", "parts", "precision",
+            ) if k in cfg
+        )
+        lines.append(
+            f"run: family={meta.get('family', '?')} {desc}".rstrip()
+        )
+        lines.append(
+            f"devices: {meta.get('device_count')} x {meta.get('platform')} "
+            f"({meta.get('device_kind')})  mesh={meta.get('mesh')}  "
+            f"jax {meta.get('jax_version')}"
+        )
+        if meta.get("hatches"):
+            lines.append(
+                "hatches: " + " ".join(
+                    f"{k}={v}" for k, v in sorted(meta["hatches"].items())
+                )
+            )
+
+    # -- step timings ------------------------------------------------------
+    if measured:
+        ms = sorted(float(r["ms"]) for r in measured)
+        mean = sum(ms) / len(ms)
+        med = _pct(ms, 0.5)
+        lines.append(
+            f"steps: {len(measured)} measured, {warmup} warmup dropped"
+        )
+        lines.append(
+            f"step time ms: mean {mean:.2f}  median {med:.2f}  "
+            f"p10 {_pct(ms, 0.10):.2f}  p90 {_pct(ms, 0.90):.2f}  "
+            f"min {ms[0]:.2f}"
+        )
+        ips = [float(r["images_per_sec"]) for r in measured]
+        last_loss = measured[-1].get("loss")
+        lines.append(
+            f"images/sec: mean {sum(ips) / len(ips):.3f}  last-loss "
+            + (f"{last_loss:.4f}" if last_loss is not None else "n/a")
+        )
+    else:
+        med = None
+        lines.append(f"steps: 0 measured, {warmup} warmup dropped")
+
+    # -- memory watermark --------------------------------------------------
+    dev_peaks = [r.get("memory_peak_bytes") for r in steps
+                 if r.get("memory_peak_bytes") is not None]
+    rss_peaks = [r.get("host_rss_peak_bytes") for r in steps
+                 if r.get("host_rss_peak_bytes") is not None]
+    if dev_peaks:
+        lines.append(f"memory watermark: {_fmt_bytes(max(dev_peaks))} "
+                     "(device peak_bytes_in_use)")
+    elif rss_peaks:
+        lines.append(f"memory watermark: {_fmt_bytes(max(rss_peaks))} "
+                     "(host peak RSS; backend reports no device stats)")
+    else:
+        lines.append("memory watermark: n/a")
+
+    # -- retraces ----------------------------------------------------------
+    sizes = [r.get("jit_cache_size") for r in steps
+             if r.get("jit_cache_size") is not None]
+    if sizes:
+        if max(sizes) <= 2:
+            # 2 variants is the normal donate+reshard pattern: the first call
+            # sees unsharded inputs, every later call the mesh-sharded state.
+            note = ""
+        else:
+            note = "  RETRACE HAZARD (shape/dtype/sharding churn in the loop)"
+        lines.append(f"compiled step variants (jit cache): {max(sizes)}{note}")
+
+    # -- derived cost metrics ----------------------------------------------
+    if cost is not None:
+        flops = cost.get("flops")
+        nbytes = cost.get("bytes_accessed")
+        ai = cost.get("arithmetic_intensity")
+        if flops:
+            lines.append(
+                f"cost model: flops/step {flops:.4g}  bytes/step "
+                f"{_fmt_bytes(nbytes)}  arithmetic intensity "
+                + (f"{ai:.2f} flops/byte" if ai else "n/a")
+            )
+        else:
+            lines.append("cost model: n/a (backend lacks cost_analysis)")
+        peak = cost.get("peak_flops")
+        ndev = cost.get("device_count") or 1
+        # flops is per-device (the one SPMD module each device runs), so
+        # utilization is against ONE device's peak.
+        util = mfu(flops, med, peak)
+        if util is not None:
+            lines.append(
+                f"mfu estimate: {util:.4f} "
+                f"(median step, per-device peak {peak:.3g} FLOP/s, "
+                f"{ndev} devices, peak source: {cost.get('peak_source')})"
+            )
+        else:
+            lines.append("mfu estimate: n/a (missing flops, steps, or peak)")
+        coll = cost.get("collectives") or {}
+        if coll:
+            lines.append("collectives per step (compiled HLO):")
+            for cls in COLLECTIVE_CLASSES:
+                c = coll.get(cls) or {}
+                lines.append(
+                    f"  {cls:<19} count {c.get('count', 0):>4}  "
+                    f"bytes {_fmt_bytes(c.get('bytes', 0))}"
+                )
+            lines.append(
+                f"  {'total':<19} count {coll.get('total_count', 0):>4}  "
+                f"bytes {_fmt_bytes(coll.get('total_bytes', 0))}"
+            )
+    return "\n".join(lines)
+
+
+def render(paths: Sequence[str]) -> str:
+    return "\n\n".join(render_run(p) for p in paths)

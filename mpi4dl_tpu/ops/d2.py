@@ -37,6 +37,7 @@ import dataclasses
 
 from mpi4dl_tpu.layer_ctx import ApplyCtx
 from mpi4dl_tpu.layers import BatchNorm, Conv2d, Identity, Pool2d, ReLU, Softmax
+from mpi4dl_tpu.obs.scopes import scope
 from mpi4dl_tpu.ops.halo import HaloSpec, halo_exchange_2d
 
 
@@ -291,18 +292,20 @@ def run_layers_d2(layers: Sequence, params_seq, x, ctx: ApplyCtx):
     hh, hw = accumulated_halo(layers)
     mh = hh if sharded_h else 0
     mw = hw if sharded_w else 0
-    x = halo_exchange_2d(
-        x,
-        HaloSpec.symmetric(mh),
-        HaloSpec.symmetric(mw),
-        sp.axis_h,
-        sp.axis_w,
-        sp.grid_h,
-        sp.grid_w,
-        rep_h=sp.rep_h,
-        rep_w=sp.rep_w,
-    )
-    y, mh_out, mw_out = apply_layers_premargin(layers, params_seq, x, ctx, mh, mw)
+    with scope(f"halo_d2_fused_h{mh}w{mw}"):
+        x = halo_exchange_2d(
+            x,
+            HaloSpec.symmetric(mh),
+            HaloSpec.symmetric(mw),
+            sp.axis_h,
+            sp.axis_w,
+            sp.grid_h,
+            sp.grid_w,
+            rep_h=sp.rep_h,
+            rep_w=sp.rep_w,
+        )
+    with scope("d2_run"):
+        y, mh_out, mw_out = apply_layers_premargin(layers, params_seq, x, ctx, mh, mw)
     assert mh_out == 0 and mw_out == 0, (mh_out, mw_out)
     return y
 

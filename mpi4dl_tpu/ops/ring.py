@@ -34,6 +34,7 @@ from jax import lax
 
 from mpi4dl_tpu.compat import pcast
 
+from mpi4dl_tpu.obs.scopes import scope
 from mpi4dl_tpu.ops.halo import HaloSpec, halo_exchange_1d
 
 
@@ -151,20 +152,22 @@ def ring_attention(
 
     def body(carry, _):
         kblk, vblk, src, m, l, o = carry
-        k_pos = src * t + jnp.arange(t, dtype=jnp.int32)
-        s = block_scores(kblk, q_pos, k_pos)  # [B, H, Tq, Tk]
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        # exp(-inf - -inf) guard: rows with no valid keys yet keep m=-inf.
-        c = jnp.exp(jnp.where(jnp.isfinite(m), m - m_new, -jnp.inf))
-        p = jnp.exp(s - m_new[..., None])
-        p = jnp.where(jnp.isfinite(s), p, 0.0)
-        l_new = l * c + jnp.sum(p, axis=-1)
-        o_new = o * c[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, vblk.astype(jnp.float32)
-        )
-        kblk = lax.ppermute(kblk, axis_name, perm)
-        vblk = lax.ppermute(vblk, axis_name, perm)
-        src = lax.ppermute(src, axis_name, perm)
+        with scope("ring_step_compute"):
+            k_pos = src * t + jnp.arange(t, dtype=jnp.int32)
+            s = block_scores(kblk, q_pos, k_pos)  # [B, H, Tq, Tk]
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+            # exp(-inf - -inf) guard: rows with no valid keys yet keep m=-inf.
+            c = jnp.exp(jnp.where(jnp.isfinite(m), m - m_new, -jnp.inf))
+            p = jnp.exp(s - m_new[..., None])
+            p = jnp.where(jnp.isfinite(s), p, 0.0)
+            l_new = l * c + jnp.sum(p, axis=-1)
+            o_new = o * c[..., None] + jnp.einsum(
+                "bhqk,bkhd->bhqd", p, vblk.astype(jnp.float32)
+            )
+        with scope("ring_step_hop"):
+            kblk = lax.ppermute(kblk, axis_name, perm)
+            vblk = lax.ppermute(vblk, axis_name, perm)
+            src = lax.ppermute(src, axis_name, perm)
         return (kblk, vblk, src, m_new, l_new, o_new), None
 
     # Accumulators start device-uniform but become device-varying in the loop:
@@ -209,21 +212,23 @@ def _ring_attention_flash(q, k, v, axis_name, n, causal, scale, interpret):
             )
             return mlo_merge((o, m, l), blk)
 
-        if causal:
-            # A source block entirely in this device's future (src > my)
-            # contributes exactly zero through the mask guard (blk =
-            # (0, -inf, 0), an mlo_merge identity) — skip the kernel for
-            # those ~n/2 hops instead of computing a fully-masked block
-            # (ADVICE r3).  shard_map is per-device code, so the varying
-            # predicate legitimately branches per device.
-            o, m, l = lax.cond(
-                src <= my, compute, lambda m, l, o: (o, m, l), m, l, o
-            )
-        else:
-            o, m, l = compute(m, l, o)
-        kblk = lax.ppermute(kblk, axis_name, perm)
-        vblk = lax.ppermute(vblk, axis_name, perm)
-        src = lax.ppermute(src, axis_name, perm)
+        with scope("ring_step_compute"):
+            if causal:
+                # A source block entirely in this device's future (src > my)
+                # contributes exactly zero through the mask guard (blk =
+                # (0, -inf, 0), an mlo_merge identity) — skip the kernel for
+                # those ~n/2 hops instead of computing a fully-masked block
+                # (ADVICE r3).  shard_map is per-device code, so the varying
+                # predicate legitimately branches per device.
+                o, m, l = lax.cond(
+                    src <= my, compute, lambda m, l, o: (o, m, l), m, l, o
+                )
+            else:
+                o, m, l = compute(m, l, o)
+        with scope("ring_step_hop"):
+            kblk = lax.ppermute(kblk, axis_name, perm)
+            vblk = lax.ppermute(vblk, axis_name, perm)
+            src = lax.ppermute(src, axis_name, perm)
         return (kblk, vblk, src, m, l, o), None
 
     vcast = lambda t_: pcast(t_, (axis_name,), to="varying")

@@ -41,6 +41,7 @@ from mpi4dl_tpu.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from mpi4dl_tpu.layer_ctx import ApplyCtx
+from mpi4dl_tpu.obs.scopes import scope
 from mpi4dl_tpu.parallel.partition import StagePartition
 from mpi4dl_tpu.parallel.pipeline import PipelineState
 from mpi4dl_tpu.parallel.stage_common import (
@@ -89,13 +90,17 @@ def make_gems_train_step(
 
         def loss_and_metrics(flat_params):
             # The reverse replica's params: device d gets stage S-1-d's row.
-            mirror_params = lax.ppermute(flat_params, AXIS_STAGE, mirror_perm)
-            loss_acc, acc_acc, stA, stB = gems_dual_scan(
-                part, branches, flat_params, mirror_params, xs, ys,
-                vary_axes=(AXIS_STAGE,) + grad_axes,
-                from_probs=from_probs,
-                compute_dtype=compute_dtype,
-            )
+            with scope("gems_mirror"):
+                mirror_params = lax.ppermute(
+                    flat_params, AXIS_STAGE, mirror_perm
+                )
+            with scope("gems_dual_scan"):
+                loss_acc, acc_acc, stA, stB = gems_dual_scan(
+                    part, branches, flat_params, mirror_params, xs, ys,
+                    vary_axes=(AXIS_STAGE,) + grad_axes,
+                    from_probs=from_probs,
+                    compute_dtype=compute_dtype,
+                )
             denom = 2 * times * Pn
             loss = lax.psum(loss_acc, AXIS_STAGE) / denom
             acc = lax.psum(acc_acc, AXIS_STAGE) / denom
@@ -113,7 +118,8 @@ def make_gems_train_step(
         )(flat_params)
         if grad_axes:
             grads = lax.pmean(grads, grad_axes)
-        new_flat, new_opt = optimizer.update(flat_params, grads, opt_state)
+        with scope("optimizer_update"):
+            new_flat, new_opt = optimizer.update(flat_params, grads, opt_state)
         if with_stats:
             if grad_axes:
                 stats = lax.pmean(stats, grad_axes)

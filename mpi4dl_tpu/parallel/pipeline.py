@@ -41,6 +41,7 @@ from mpi4dl_tpu.compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from mpi4dl_tpu.layer_ctx import ApplyCtx
+from mpi4dl_tpu.obs.scopes import scope
 from mpi4dl_tpu.parallel.partition import StagePartition
 from mpi4dl_tpu.parallel.stage_common import (
     gpipe_scan,
@@ -101,12 +102,13 @@ def make_pipeline_train_step(
         y_parts = labels.reshape(Pn, mb)
 
         def loss_and_metrics(flat_params):
-            loss_acc, acc_acc, st_acc = gpipe_scan(
-                part, branches, flat_params, x_parts, y_parts,
-                vary_axes=(AXIS_STAGE,) + grad_axes,
-                from_probs=from_probs,
-                compute_dtype=compute_dtype,
-            )
+            with scope("gpipe_scan"):
+                loss_acc, acc_acc, st_acc = gpipe_scan(
+                    part, branches, flat_params, x_parts, y_parts,
+                    vary_axes=(AXIS_STAGE,) + grad_axes,
+                    from_probs=from_probs,
+                    compute_dtype=compute_dtype,
+                )
             # Only the last stage accumulated; psum broadcasts to all stages
             # (and sums over data-parallel groups' mean below).
             loss = lax.psum(loss_acc, AXIS_STAGE) / Pn
@@ -124,7 +126,8 @@ def make_pipeline_train_step(
             loss = loss / loss_scale
         if grad_axes:
             grads = lax.pmean(grads, grad_axes)
-        new_flat, new_opt = optimizer.update(flat_params, grads, opt_state)
+        with scope("optimizer_update"):
+            new_flat, new_opt = optimizer.update(flat_params, grads, opt_state)
         if with_stats:
             if grad_axes:
                 stats = lax.pmean(stats, grad_axes)

@@ -60,6 +60,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from mpi4dl_tpu.cells import CellModel
 from mpi4dl_tpu.layer_ctx import ApplyCtx, SpatialCtx
+from mpi4dl_tpu.obs.scopes import scope
 import numpy as np
 
 from mpi4dl_tpu.parallel.partition import (
@@ -300,7 +301,8 @@ def _make_sp_step(
 
         if remat:
             region = jax.checkpoint(region)
-        act, sp_stats = region(params_sp, xs.astype(compute_dtype))
+        with scope("sp_region"):
+            act, sp_stats = region(params_sp, xs.astype(compute_dtype))
         # Junction: mosaic-merge tiles; batch-split for LOCAL_DP_LP (via the
         # all_to_all fast path when every tile device takes a distinct shard
         # — degree x less ICI traffic and junction memory than gather+slice).
@@ -311,7 +313,8 @@ def _make_sp_step(
             t = lax.all_gather(t, AXIS_STAGE, axis=0, tiled=True)
             return t.reshape(*lead_shape, spp.mb_tail, *t.shape[1:])
 
-        return jax.tree.map(g, act), sp_stats
+        with scope("stage_lineup"):
+            return jax.tree.map(g, act), sp_stats
 
     def labels_to_parts(labels):
         """The same index transform phase1 applies to images (chunk by stage
@@ -334,9 +337,10 @@ def _make_sp_step(
 
         def loss_and_metrics(sp_flat, tail_flat):
             x_parts, sp_stats = phase1(sp_flat, x)
-            loss_acc, acc_acc, tail_stats = scan_fn(
-                branches, tail_flat, x_parts, y_parts, vary_axes
-            )
+            with scope("tail_scan"):
+                loss_acc, acc_acc, tail_stats = scan_fn(
+                    branches, tail_flat, x_parts, y_parts, vary_axes
+                )
             loss = lax.psum(loss_acc, AXIS_STAGE) / denom
             acc = lax.psum(acc_acc, AXIS_STAGE) / denom
             if tile_axes:
@@ -361,8 +365,11 @@ def _make_sp_step(
             g_sp = lax.pmean(g_sp, grad_axes)
             g_tail = lax.pmean(g_tail, grad_axes)
 
-        new_sp, new_opt_sp = optimizer.update(sp_buf, g_sp, opt_sp)
-        new_tail, new_opt_tail = optimizer.update(tail_flat, g_tail, opt_tail)
+        with scope("optimizer_update"):
+            new_sp, new_opt_sp = optimizer.update(sp_buf, g_sp, opt_sp)
+            new_tail, new_opt_tail = optimizer.update(
+                tail_flat, g_tail, opt_tail
+            )
         if with_stats_sp:
             # Spatial stats vary over stage (distinct batch chunks) and data;
             # the tile axes are already reduced inside BN (cross-tile psum) or
@@ -474,7 +481,8 @@ def make_sp_gems_train_step(
     mirror_perm = [(i, S - 1 - i) for i in range(S)]
 
     def scan_fn(branches, tail_flat, x_parts, y_parts, vary_axes):
-        mirror_params = lax.ppermute(tail_flat, AXIS_STAGE, mirror_perm)
+        with scope("gems_mirror"):
+            mirror_params = lax.ppermute(tail_flat, AXIS_STAGE, mirror_perm)
         loss_acc, acc_acc, stA, stB = gems_dual_scan(
             part, branches, tail_flat, mirror_params, x_parts, y_parts,
             vary_axes=vary_axes,

@@ -39,6 +39,7 @@ from jax import lax
 
 from mpi4dl_tpu.cells import CellModel
 from mpi4dl_tpu.layer_ctx import ApplyCtx, SpatialCtx
+from mpi4dl_tpu.obs.scopes import scope
 
 Act = Union[jax.Array, Tuple[jax.Array, ...]]
 Levels = Sequence[Tuple[int, SpatialCtx]]
@@ -171,10 +172,13 @@ def apply_junction(x: Act, sp_last: SpatialCtx, junction: str,
             f"batch {n} not divisible by junction degree {degree}"
         )
         if can_all_to_all_junction(sp_last, degree):
-            return batch_split_all_to_all(x, sp_last)
-        x = gather_spatial(x, sp_last)
-        return scatter_batch_over_tiles(x, sp_last, degree=degree)
-    return gather_spatial(x, sp_last)
+            with scope("junction_batch_split_a2a"):
+                return batch_split_all_to_all(x, sp_last)
+        with scope("junction_batch_split"):
+            x = gather_spatial(x, sp_last)
+            return scatter_batch_over_tiles(x, sp_last, degree=degree)
+    with scope("junction_gather"):
+        return gather_spatial(x, sp_last)
 
 
 def respatial(x: Act, sp_from: SpatialCtx, sp_to: SpatialCtx,
@@ -233,10 +237,11 @@ def apply_spatial_region(
     tile_axes = tuple(a for a in (levels[0][1].axis_h, levels[0][1].axis_w) if a)
     start = 0
     prev: Optional[SpatialCtx] = None
-    for stop, sp_l in levels:
+    for li, (stop, sp_l) in enumerate(levels):
         assert stop > start, f"empty spatial level [{start}, {stop})"
         if prev is not None:
-            x = respatial(x, prev, sp_l)
+            with scope(f"respatial_l{li}"):
+                x = respatial(x, prev, sp_l)
         if sp_l.active:
             c = ctx.with_spatial(sp_l)
         else:
@@ -247,7 +252,10 @@ def apply_spatial_region(
         # region-level checkpoint's backward holds every cell's internals
         # at once (measured 148 GB/device at the 8192² flagship; the
         # readiness artifact's discovery, PERF_NOTES r4).
-        x = model.apply(params_list, x, c, start=start, stop=stop, remat=remat)
+        with scope(f"sp_level{li}"):
+            x = model.apply(
+                params_list, x, c, start=start, stop=stop, remat=remat
+            )
         start, prev = stop, sp_l
     assert prev is not None
     return x, prev

@@ -23,6 +23,7 @@ from jax import lax
 from mpi4dl_tpu.compat import pcast
 
 from mpi4dl_tpu.layer_ctx import ApplyCtx
+from mpi4dl_tpu.obs.scopes import scope
 from mpi4dl_tpu.parallel.partition import StagePartition, lax_slice, pad_to
 from mpi4dl_tpu.train import accuracy, cross_entropy
 from mpi4dl_tpu.mesh import AXIS_STAGE
@@ -67,8 +68,10 @@ def make_stage_branches(
             else:
                 sink, c = None, ctx
             y = act
-            for i in range(r0, r1):
-                y = part.model.cells[i].apply(params[i - r0], y, c)
+            with scope(f"stage{s}"):
+                for i in range(r0, r1):
+                    with scope(f"cell{i:02d}"):
+                        y = part.model.cells[i].apply(params[i - r0], y, c)
             out = pad_to(out_pk.pack(y, compute_dtype), part.act_max)
             if not stat_n:
                 return out, jnp.zeros((0,), jnp.float32)
@@ -131,12 +134,14 @@ def gpipe_scan(
 
     def tick(carry, t):
         buf, loss_acc, acc_acc, st_acc = carry
-        p_in = jnp.clip(t, 0, Pn - 1)
-        xp = jax.tree.map(
-            lambda a: lax.dynamic_index_in_dim(a, p_in, keepdims=False), x_parts
-        )
-        inj = pad_to(in_pack0.pack(xp, compute_dtype), amax)
-        buf = jnp.where(s_idx == 0, inj, buf)
+        with scope("mb_inject"):
+            p_in = jnp.clip(t, 0, Pn - 1)
+            xp = jax.tree.map(
+                lambda a: lax.dynamic_index_in_dim(a, p_in, keepdims=False),
+                x_parts,
+            )
+            inj = pad_to(in_pack0.pack(xp, compute_dtype), amax)
+            buf = jnp.where(s_idx == 0, inj, buf)
         y, st = lax.switch(s_idx, branches, flat_params, buf)
         # Stage s computes part p = t - s; stats only count on valid ticks.
         st_valid = (t >= s_idx) & (t - s_idx < Pn)
@@ -154,7 +159,10 @@ def gpipe_scan(
         acc_acc = acc_acc + jnp.where(valid, a, 0.0)
         # Hand activations to the next stage (non-wrap: stage 0's stale recv
         # is overwritten by injection next tick).
-        buf = lax.ppermute(y, AXIS_STAGE, [(i, i + 1) for i in range(S - 1)])
+        with scope("stage_handoff"):
+            buf = lax.ppermute(
+                y, AXIS_STAGE, [(i, i + 1) for i in range(S - 1)]
+            )
         return (buf, loss_acc, acc_acc, st_acc), None
 
     # Initial carries must be marked varying over the axes the loop makes
@@ -287,8 +295,9 @@ def gems_dual_scan(
                 + jnp.where(validA, accuracy(logitsA, lblA), 0.0)
                 + jnp.where(validB, accuracy(logitsB, lblB), 0.0)
             )
-            bufA = lax.ppermute(yA, AXIS_STAGE, fwd_perm)
-            bufB = lax.ppermute(yB, AXIS_STAGE, bwd_perm)
+            with scope("stage_handoff"):
+                bufA = lax.ppermute(yA, AXIS_STAGE, fwd_perm)
+                bufB = lax.ppermute(yB, AXIS_STAGE, bwd_perm)
             return (bufA, bufB, l_acc, a_acc, stA, stB), None
 
         init = (

@@ -36,29 +36,70 @@ class Timer:
         return self.ms
 
 
+def _percentile(sorted_ms: List[float], q: float) -> float:
+    """Linear-interpolated percentile of an already-sorted sample."""
+    if len(sorted_ms) == 1:
+        return sorted_ms[0]
+    pos = q * (len(sorted_ms) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_ms) - 1)
+    frac = pos - lo
+    return sorted_ms[lo] * (1 - frac) + sorted_ms[hi] * frac
+
+
 class StepMeter:
     """Collects per-step times and prints images/sec the way the reference
     benchmarks do (mean/median over steps, reference
-    benchmark_amoebanet_sp.py:322-367)."""
+    benchmark_amoebanet_sp.py:322-367).
 
-    def __init__(self, batch_size: int) -> None:
+    ``warmup_steps`` makes the compile-step exclusion explicit: the first
+    `warmup_steps` ``add()`` calls are counted (``warmup_dropped``) but
+    excluded from the statistics — replacing the epoch-loop's implicit
+    ``epoch > 0 or i > 0`` skip.  ``add`` returns whether the sample was
+    measured, so telemetry can tag records."""
+
+    def __init__(self, batch_size: int, warmup_steps: int = 0) -> None:
         self.batch_size = batch_size
+        self.warmup_steps = warmup_steps
+        self.warmup_dropped = 0
         self.times_ms: List[float] = []
 
-    def add(self, ms: float) -> None:
+    def add(self, ms: float) -> bool:
+        if self.warmup_dropped < self.warmup_steps:
+            self.warmup_dropped += 1
+            return False
         self.times_ms.append(ms)
+        return True
 
     def images_per_sec(self) -> float:
         if not self.times_ms:
             return 0.0
         return self.batch_size / (statistics.mean(self.times_ms) / 1e3)
 
+    def stats(self) -> dict:
+        """mean/median/p10/p90/min over the measured (post-warmup) steps."""
+        if not self.times_ms:
+            return {"steps": 0, "warmup_dropped": self.warmup_dropped}
+        s = sorted(self.times_ms)
+        return {
+            "steps": len(s),
+            "warmup_dropped": self.warmup_dropped,
+            "mean_ms": statistics.mean(s),
+            "median_ms": statistics.median(s),
+            "p10_ms": _percentile(s, 0.10),
+            "p90_ms": _percentile(s, 0.90),
+            "min_ms": s[0],
+            "images_per_sec": self.images_per_sec(),
+        }
+
     def summary(self) -> str:
         if not self.times_ms:
             return "no steps recorded"
-        mean = statistics.mean(self.times_ms)
-        med = statistics.median(self.times_ms)
+        st = self.stats()
         return (
-            f"steps={len(self.times_ms)} mean={mean:.2f}ms median={med:.2f}ms "
-            f"images/sec={self.images_per_sec():.3f}"
+            f"steps={st['steps']} mean={st['mean_ms']:.2f}ms "
+            f"median={st['median_ms']:.2f}ms p10={st['p10_ms']:.2f}ms "
+            f"p90={st['p90_ms']:.2f}ms min={st['min_ms']:.2f}ms "
+            f"warmup_dropped={st['warmup_dropped']} "
+            f"images/sec={st['images_per_sec']:.3f}"
         )

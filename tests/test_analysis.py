@@ -469,5 +469,74 @@ def test_cli_exit_codes(tmp_path):
     )
     assert r.returncode == 0
     for name in ("collective-axis", "tracer-leak", "dtype-policy",
-                 "env-hatch", "retrace"):
+                 "env-hatch", "retrace", "print-call"):
         assert name in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# (7) print-call
+# ---------------------------------------------------------------------------
+
+
+def test_print_call_positive(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        def f():
+            print("library chatter")
+        """,
+        rule="print-call",
+    )
+    assert len(vs) == 1 and "print()" in vs[0].message
+
+
+def test_print_call_benchmarks_exempt(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        def f():
+            print("benchmark output line")
+        """,
+        rule="print-call",
+        filename="benchmarks/foo.py",
+    )
+    assert vs == []
+
+
+def test_print_call_main_cli_exempt(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        def main():
+            print("the CLI's product is stdout")
+        """,
+        rule="print-call",
+        filename="mpi4dl_tpu/obs/__main__.py",
+    )
+    assert vs == []
+
+
+def test_print_call_pragma_suppresses(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        def f():
+            print("accepted")  # analysis: ok(print-call)
+        """,
+        rule="print-call",
+    )
+    assert vs == []
+
+
+def test_print_call_shadowed_print_not_flagged(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        from rich import print
+
+        def f():
+            print("not the builtin")
+        """,
+        rule="print-call",
+    )
+    assert vs == []
