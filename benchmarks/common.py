@@ -32,13 +32,8 @@ first backend initialization, so the runner applies it just in time).
 
 from __future__ import annotations
 
-import contextlib
 import os
-import queue
 import sys
-import threading
-
-_NULL_CTX = contextlib.nullcontext()
 
 # Make `mpi4dl_tpu` importable when a benchmark script is run by path.
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -48,7 +43,7 @@ if _REPO not in sys.path:
 from mpi4dl_tpu.config import (
     ParallelConfig, config_from_args, get_parser, resolve_pallas_conv,
 )
-from mpi4dl_tpu.utils import StepMeter, Timer
+from mpi4dl_tpu.utils import StepMeter
 
 
 def _spatial_levels(cfg: ParallelConfig, n_cells: int):
@@ -264,64 +259,6 @@ def _ensure_devices(need: int) -> None:
     ensure_host_device_count(max(need, 8))
 
 
-def _batches(dataset, batch_size: int, steps: int, num_workers: int):
-    """Host batch iterator; num_workers>0 prefetches on a background thread
-    (the reference's DataLoader num_workers analog).
-
-    Early consumer exit (exception mid-epoch, generator close) must not
-    strand the producer: a plain ``q.put`` on a full queue would block
-    forever holding batch memory once nobody drains it.  The producer
-    therefore puts with a timeout while polling a stop event, and the
-    generator's ``finally`` sets the event and drains the queue so the
-    thread always terminates.  A producer-side exception (dataset I/O)
-    rides the queue as a sentinel and re-raises in the consumer — a dead
-    producer must not leave the consumer blocked on ``q.get()``."""
-    if num_workers <= 0:
-        for i in range(steps):
-            yield dataset.batch(i, batch_size)
-        return
-    q: queue.Queue = queue.Queue(maxsize=max(2, num_workers))
-    stop = threading.Event()
-
-    def _put(item) -> bool:
-        while not stop.is_set():
-            try:
-                q.put(item, timeout=0.1)
-                return True
-            except queue.Full:
-                continue
-        return False
-
-    def producer():
-        try:
-            for i in range(steps):
-                if stop.is_set() or not _put(dataset.batch(i, batch_size)):
-                    return
-        except BaseException as e:  # noqa: BLE001 — forwarded to consumer
-            _put(e)
-            return
-        _put(None)  # end-of-epoch sentinel
-
-    t = threading.Thread(target=producer, daemon=True)
-    t.start()
-    try:
-        while True:
-            item = q.get()
-            if item is None:
-                return
-            if isinstance(item, BaseException):
-                raise item
-            yield item
-    finally:
-        stop.set()
-        while True:  # unblock a producer waiting on a full queue
-            try:
-                q.get_nowait()
-            except queue.Empty:
-                break
-        t.join(timeout=5.0)
-
-
 def _open_telemetry(directory, family, cfg, spec, step, state, dataset,
                     global_batch, argv):
     """Open a RunLog and write the meta + compiled-step cost records.
@@ -392,6 +329,13 @@ def run(family: str, model: str, argv=None) -> dict:
              "directory; render with `python -m mpi4dl_tpu.obs report` "
              "(docs/observability.md)",
     )
+    parser.add_argument(
+        "--watchdog-secs", type=float, default=None,
+        help="step wall-clock budget: a step (batch fetch + device step) "
+             "exceeding it dumps live Python stacks + the last RunLog "
+             "record to stderr (default: MPI4DL_WATCHDOG_SECS, else off; "
+             "docs/resilience.md)",
+    )
     args = parser.parse_args(argv)
     cfg = config_from_args(args)
     if cfg.verbose:
@@ -431,13 +375,27 @@ def run(family: str, model: str, argv=None) -> dict:
     step, state, eval_params_fn, global_batch = build_train(cfg, family, mesh)
 
     # Optional checkpoint resume (reference has no checkpointing; SURVEY §5
-    # plans it as a new capability).
+    # plans it as a new capability).  restore_latest returns the step id the
+    # checkpoint was taken at, so a resumed run continues the global step
+    # count and batch sequence instead of restarting at 0.
     ckpt_mgr = None
+    start_step = 0
     if cfg.checkpoint_dir:
-        from mpi4dl_tpu.checkpoint import CheckpointManager
+        from mpi4dl_tpu.checkpoint import CheckpointManager, config_fingerprint
 
-        ckpt_mgr = CheckpointManager(cfg.checkpoint_dir)
-        state = ckpt_mgr.restore_latest(state)
+        # steps_per_epoch is fingerprinted too: it defines the global-step →
+        # batch-index mapping and the checkpoint cadence, so resuming with a
+        # different value would replay different data while claiming the
+        # bit-identical-resume contract.
+        ckpt_mgr = CheckpointManager(
+            cfg.checkpoint_dir,
+            fingerprint=config_fingerprint(
+                cfg, spec, {"steps_per_epoch": args.steps_per_epoch}
+            ),
+        )
+        state, start_step = ckpt_mgr.restore_latest(state)
+        if start_step:
+            print(f"resuming from checkpoint step {start_step}")
 
     dataset = make_dataset(cfg)
     steps = args.steps_per_epoch
@@ -445,14 +403,24 @@ def run(family: str, model: str, argv=None) -> dict:
     # explicitly (and reports the drop count) instead of the old implicit
     # `epoch > 0 or i > 0` skip.
     meter = StepMeter(global_batch, warmup_steps=1)
-    timer = Timer()
-    metrics = {}
 
     runlog = None
     if args.telemetry_dir:
         runlog = _open_telemetry(
             args.telemetry_dir, family, cfg, spec, step, state, dataset,
             global_batch, argv,
+        )
+
+    # The supervised loop (mpi4dl_tpu/resilience/loop.py) owns the epoch
+    # structure: anomaly guard + rollback, preemption-safe checkpointing
+    # through the background writer, fault injection, step watchdog.
+    from mpi4dl_tpu.resilience import AnomalyGuard, FaultInjector, run_supervised
+    from mpi4dl_tpu.resilience.watchdog import watchdog_budget_from_env
+
+    if start_step >= cfg.num_epochs * steps:
+        print(
+            f"note: checkpoint step {start_step} already covers "
+            f"{cfg.num_epochs} epoch(s) x {steps} steps — nothing to run"
         )
 
     # try/finally: a crash mid-epoch must still flush the profiler trace
@@ -462,36 +430,22 @@ def run(family: str, model: str, argv=None) -> dict:
     if args.profile_dir:
         jax.profiler.start_trace(args.profile_dir)
     try:
-        from mpi4dl_tpu.obs import step_annotation
-
-        gstep = 0
-        for epoch in range(cfg.num_epochs):
-            for i, (x, y) in enumerate(
-                _batches(dataset, global_batch, steps, cfg.num_workers)
-            ):
-                timer.start()
-                with step_annotation(gstep) if args.profile_dir else (
-                    _NULL_CTX
-                ):
-                    state, metrics = step(state, x, y)
-                    loss = float(metrics["loss"])  # blocks until step finishes
-                ms = timer.stop()
-                measured = meter.add(ms)
-                print(
-                    f"epoch {epoch} step {i} time_ms {ms:.1f} "
-                    f"images_per_sec {global_batch / (ms / 1e3):.3f} "
-                    f"loss {loss:.4f} acc {float(metrics['accuracy']):.4f}"
-                )
-                if runlog is not None:
-                    runlog.write_step(
-                        epoch=epoch, step=i, ms=ms,
-                        images_per_sec=global_batch / (ms / 1e3),
-                        loss=loss, accuracy=float(metrics["accuracy"]),
-                        step_fn=step, measured=measured,
-                    )
-                gstep += 1
-            if ckpt_mgr is not None:
-                ckpt_mgr.save(state, step_id=(epoch + 1) * steps)
+        result = run_supervised(
+            step, state, dataset,
+            global_batch=global_batch,
+            steps_per_epoch=steps,
+            num_epochs=cfg.num_epochs,
+            num_workers=cfg.num_workers,
+            start_step=start_step,
+            ckpt=ckpt_mgr,
+            runlog=runlog,
+            meter=meter,
+            print_fn=print,
+            profile=bool(args.profile_dir),
+            guard=AnomalyGuard.from_env(),
+            faults=FaultInjector.from_env(),
+            watchdog_secs=watchdog_budget_from_env(args.watchdog_secs),
+        )
     finally:
         if args.profile_dir:
             jax.profiler.stop_trace()
@@ -504,7 +458,10 @@ def run(family: str, model: str, argv=None) -> dict:
     print(meter.summary())
     return {
         "images_per_sec": meter.images_per_sec(),
-        "loss": float(metrics["loss"]) if metrics else float("nan"),
+        "loss": result.metrics.get("loss", float("nan")),
         "steps": len(meter.times_ms),
+        "final_step": result.final_step,
+        "preempted": result.preempted,
+        "anomalies": result.anomalies,
         "telemetry_path": runlog.path if runlog is not None else None,
     }

@@ -11,6 +11,7 @@ from mpi4dl_tpu.analysis.rules_dtype import RULE as _dtype
 from mpi4dl_tpu.analysis.rules_env import RULE as _env
 from mpi4dl_tpu.analysis.rules_print import RULE as _print
 from mpi4dl_tpu.analysis.rules_retrace import RULE as _retrace
+from mpi4dl_tpu.analysis.rules_swallow import RULE as _swallow
 from mpi4dl_tpu.analysis.rules_tracer import RULE as _tracer
 
 RULE_TABLE: List[Rule] = [
@@ -20,6 +21,7 @@ RULE_TABLE: List[Rule] = [
     _env,
     _retrace,
     _print,
+    _swallow,
 ]
 
 RULES_BY_NAME: Dict[str, Rule] = {r.name: r for r in RULE_TABLE}

@@ -1,0 +1,93 @@
+"""Rule ``swallow-except`` (rule 8): no silent exception swallowing in
+library modules.
+
+A resilience layer is only as honest as its error paths: a bare ``except:``
+or an ``except Exception: pass`` in library code hides exactly the failures
+the guard/watchdog/RunLog exist to surface (and a bare ``except:`` also eats
+``KeyboardInterrupt``/``SystemExit`` — it can break the preemption handler's
+clean-exit contract).  Flagged:
+
+- ``except:`` with no exception type, regardless of body;
+- ``except Exception:`` / ``except BaseException:`` (bare or ``as e``, alone
+  or in a tuple) whose body is ONLY ``pass`` / ``...`` — a handler that
+  logs, falls back, or re-raises is deliberate and allowed.
+
+Scope: files under ``mpi4dl_tpu/`` only (benchmarks/tests/harness are out of
+scope by construction).  A justified swallow carries the standard pragma
+``# analysis: ok(swallow-except)`` on the handler line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from mpi4dl_tpu.analysis.core import Project, Rule, Violation
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _names_broad(src, node: ast.expr) -> bool:
+    """True when the except type (or any member of a tuple) resolves to
+    Exception/BaseException."""
+    if isinstance(node, ast.Tuple):
+        return any(_names_broad(src, elt) for elt in node.elts)
+    resolved = src.resolve(node)
+    return resolved in _BROAD or resolved in {f"builtins.{n}" for n in _BROAD}
+
+
+def _body_only_swallows(body: List[ast.stmt]) -> bool:
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if (
+            isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Constant)
+            and stmt.value.value is Ellipsis
+        ):
+            continue
+        return False
+    return True
+
+
+class SwallowExceptRule(Rule):
+    name = "swallow-except"
+    description = (
+        "bare `except:` or `except (Base)Exception: pass` in mpi4dl_tpu/ "
+        "library modules — name the exception types, or log/handle/re-raise "
+        "(pragma: # analysis: ok(swallow-except))."
+    )
+
+    def check(self, project: Project) -> List[Violation]:
+        out: List[Violation] = []
+        for src in project.package_files():
+            for node in ast.walk(src.tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                if node.type is None:
+                    out.append(
+                        Violation(
+                            self.name,
+                            src.rel,
+                            node.lineno,
+                            "bare `except:` swallows KeyboardInterrupt/"
+                            "SystemExit too — name the exception types",
+                        )
+                    )
+                elif _names_broad(src, node.type) and _body_only_swallows(
+                    node.body
+                ):
+                    out.append(
+                        Violation(
+                            self.name,
+                            src.rel,
+                            node.lineno,
+                            "`except (Base)Exception` whose body only "
+                            "passes — silent swallow; log, handle, or "
+                            "narrow the exception type",
+                        )
+                    )
+        return out
+
+
+RULE = SwallowExceptRule()

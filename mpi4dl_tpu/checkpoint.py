@@ -10,80 +10,265 @@ round-trip through jax.device_get / device_put with the template's sharding,
 which makes resume bit-identical including flat stage buffers and optimizer
 state.
 
-Writes are atomic (tmp file + rename) so a killed run never leaves a torn
-checkpoint behind.
+Durability (ISSUE 3): every file embeds a ``__manifest__`` record — per-leaf
+CRC32, leaf shapes/dtypes, the step id, and an optional config/mesh
+fingerprint — and writes are tmp-file + fsync + atomic rename + directory
+fsync, so a killed run never leaves a torn checkpoint behind and silent
+corruption is detected at restore time rather than as a wrong-answer resume.
+:meth:`CheckpointManager.restore_latest` walks BACKWARD past torn or
+fingerprint-mismatched files to the newest *valid* checkpoint instead of
+raising — a corrupted newest file costs one checkpoint interval, not the run.
+
+The save path is split so the background writer
+(:class:`mpi4dl_tpu.resilience.writer.AsyncCheckpointWriter`) can run
+``device_get`` on the training thread (required: the next step donates the
+buffers) and serialization + fsync off it:
+
+    :func:`state_to_arrays`  (training thread)  →
+    :func:`write_arrays`     (any thread)
 """
 
 from __future__ import annotations
 
+import binascii
+import dataclasses
+import hashlib
+import json
+import logging
 import os
 import re
 import tempfile
-from typing import Any, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
 
 _CKPT_RE = re.compile(r"^ckpt_(\d+)\.npz$")
 
+MANIFEST_KEY = "__manifest__"
+STEP_KEY = "__step_id__"
+MANIFEST_SCHEMA = 1
 
-def save_state(path: str, state: Any, step_id: int) -> None:
-    """Write `state` (any pytree of arrays) to `path` atomically."""
+logger = logging.getLogger(__name__)
+
+
+class CheckpointInvalid(ValueError):
+    """A checkpoint file failed validation (torn zip, CRC mismatch, leaf
+    count/shape mismatch, or config/mesh fingerprint mismatch)."""
+
+
+class CheckpointMismatch(CheckpointInvalid):
+    """The checkpoint is intact but belongs to a DIFFERENT program
+    (config/mesh fingerprint, leaf count, or leaf shapes disagree with the
+    restoring run).  Unlike corruption — which is transient per-file bad
+    luck worth walking past — a mismatch is deterministic user error:
+    ``restore_latest`` raises it rather than silently fresh-starting (and
+    then pruning away the mismatched run's checkpoints)."""
+
+
+# ---------------------------------------------------------------------------
+# Fingerprint: detects "resumed into a different program" before the shape
+# checks would (or, worse, wouldn't — same shapes, different mesh/config).
+# ---------------------------------------------------------------------------
+
+# Fields that may legitimately differ between the saving and restoring run:
+# where things live, how chatty/threaded the host side is, and how LONG to
+# train (extending a finished run with more epochs must resume, not restart).
+_FP_EXCLUDE = {"checkpoint_dir", "verbose", "num_workers", "datapath",
+               "num_epochs"}
+
+
+def config_fingerprint(*parts: Any) -> str:
+    """Stable 16-hex-char digest of config-like objects (dataclasses, dicts,
+    tuples, scalars).  Volatile fields (checkpoint dir, verbosity, worker
+    count, data path, epoch count) are excluded — they don't change the
+    computed state."""
+
+    def norm(obj: Any) -> Any:
+        if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+            return norm(dataclasses.asdict(obj))
+        if isinstance(obj, dict):
+            return {
+                str(k): norm(v)
+                for k, v in sorted(obj.items(), key=lambda kv: str(kv[0]))
+                if str(k) not in _FP_EXCLUDE
+            }
+        if isinstance(obj, (list, tuple)):
+            return [norm(v) for v in obj]
+        if isinstance(obj, (set, frozenset)):
+            # hash randomization makes set iteration order process-dependent
+            return sorted((norm(v) for v in obj), key=repr)
+        if obj is None or isinstance(obj, (bool, int, float, str)):
+            return obj
+        return repr(obj)
+
+    blob = json.dumps([norm(p) for p in parts], sort_keys=True)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+# ---------------------------------------------------------------------------
+# Save path (two-phase: gather on the training thread, write anywhere)
+# ---------------------------------------------------------------------------
+
+
+def state_to_arrays(state: Any, step_id: int) -> Dict[str, np.ndarray]:
+    """Gather `state` (any pytree of arrays) to host numpy arrays.  This is
+    the half that MUST run on the training thread before the next step
+    donates the buffers; the result is safe to hand to a writer thread."""
     leaves = jax.tree.leaves(state)
     arrays = {f"leaf_{i}": np.asarray(jax.device_get(l)) for i, l in enumerate(leaves)}
-    arrays["__step_id__"] = np.asarray(step_id, np.int64)
+    arrays[STEP_KEY] = np.asarray(step_id, np.int64)
+    return arrays
+
+
+def _leaf_crc(arr: np.ndarray) -> int:
+    # crc32 reads the buffer directly — no .tobytes() copy (GB-scale stage
+    # buffers would transiently double host RSS at exactly the save moment).
+    return binascii.crc32(np.ascontiguousarray(arr)) & 0xFFFFFFFF
+
+
+def _manifest_for(arrays: Dict[str, np.ndarray], fingerprint: Optional[str]) -> dict:
+    leaves = {}
+    for k, a in arrays.items():
+        if k.startswith("leaf_"):
+            leaves[k] = {
+                "crc32": _leaf_crc(a),
+                "shape": list(a.shape),
+                "dtype": str(a.dtype),
+            }
+    return {
+        "schema": MANIFEST_SCHEMA,
+        "step_id": int(arrays[STEP_KEY]),
+        "fingerprint": fingerprint,
+        "leaves": leaves,
+    }
+
+
+def write_arrays(path: str, arrays: Dict[str, np.ndarray],
+                 fingerprint: Optional[str] = None) -> None:
+    """Serialize gathered arrays (+ manifest) to `path`: tmp file, flush,
+    fsync, atomic rename, directory fsync.  Runs on any thread."""
+    payload = dict(arrays)
+    manifest = _manifest_for(arrays, fingerprint)
+    payload[MANIFEST_KEY] = np.frombuffer(
+        json.dumps(manifest, sort_keys=True).encode(), dtype=np.uint8
+    )
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
     try:
         with os.fdopen(fd, "wb") as f:
-            np.savez(f, **arrays)
+            np.savez(f, **payload)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)
+        dfd = os.open(d, os.O_RDONLY)
+        try:
+            os.fsync(dfd)  # make the rename itself durable
+        finally:
+            os.close(dfd)
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
 
 
-def restore_state(path: str, template: Any) -> Any:
-    """Load leaves from `path` into the structure (and shardings) of
+def save_state(path: str, state: Any, step_id: int,
+               fingerprint: Optional[str] = None) -> None:
+    """Write `state` (any pytree of arrays) to `path` atomically."""
+    write_arrays(path, state_to_arrays(state, step_id), fingerprint)
+
+
+# ---------------------------------------------------------------------------
+# Restore path
+# ---------------------------------------------------------------------------
+
+
+def load_arrays(path: str, expected_fingerprint: Optional[str] = None
+                ) -> Tuple[Dict[str, np.ndarray], int]:
+    """Load and VALIDATE one checkpoint file; returns (arrays, step_id).
+
+    Raises :class:`CheckpointInvalid` on a torn/corrupt file, a per-leaf
+    CRC mismatch, or a fingerprint mismatch (both sides non-null)."""
+    try:
+        with np.load(path) as z:
+            arrays = {k: z[k] for k in z.files}
+    except Exception as e:  # zipfile/np errors on torn files vary by corruption
+        raise CheckpointInvalid(f"{path}: unreadable ({e!r})") from e
+    manifest = None
+    if MANIFEST_KEY in arrays:
+        try:
+            manifest = json.loads(bytes(arrays.pop(MANIFEST_KEY)).decode())
+        except (ValueError, UnicodeDecodeError) as e:
+            raise CheckpointInvalid(f"{path}: bad manifest ({e!r})") from e
+        fp = manifest.get("fingerprint")
+        if expected_fingerprint and fp and fp != expected_fingerprint:
+            raise CheckpointMismatch(
+                f"{path}: config/mesh fingerprint {fp} != expected "
+                f"{expected_fingerprint} (checkpoint from a different program)"
+            )
+        for k, info in manifest.get("leaves", {}).items():
+            a = arrays.get(k)
+            if a is None:
+                raise CheckpointInvalid(f"{path}: manifest leaf {k} missing")
+            if _leaf_crc(a) != info.get("crc32"):
+                raise CheckpointInvalid(f"{path}: CRC32 mismatch on {k}")
+    step = arrays.get(STEP_KEY)
+    step_id = int(step) if step is not None else int(
+        (manifest or {}).get("step_id", 0)
+    )
+    return arrays, step_id
+
+
+def arrays_to_state(arrays: Dict[str, np.ndarray], template: Any) -> Any:
+    """Map loaded leaf arrays into the structure (and shardings) of
     `template`.  Shapes/dtypes are checked leaf-by-leaf."""
     leaves, treedef = jax.tree.flatten(template)
-    with np.load(path) as z:
-        n = sum(1 for k in z.files if k.startswith("leaf_"))
-        if n != len(leaves):
-            raise ValueError(
-                f"checkpoint {path} has {n} leaves, state needs {len(leaves)}"
+    n = sum(1 for k in arrays if k.startswith("leaf_"))
+    if n != len(leaves):
+        raise CheckpointMismatch(
+            f"checkpoint has {n} leaves, state needs {len(leaves)}"
+        )
+    new_leaves = []
+    for i, tmpl in enumerate(leaves):
+        arr = arrays[f"leaf_{i}"]
+        tshape = tuple(getattr(tmpl, "shape", np.shape(tmpl)))
+        if tuple(arr.shape) != tshape:
+            raise CheckpointMismatch(
+                f"leaf {i}: checkpoint shape {arr.shape} != state {tshape}"
             )
-        new_leaves = []
-        for i, tmpl in enumerate(leaves):
-            arr = z[f"leaf_{i}"]
-            tshape = tuple(getattr(tmpl, "shape", np.shape(tmpl)))
-            if tuple(arr.shape) != tshape:
-                raise ValueError(
-                    f"leaf {i}: checkpoint shape {arr.shape} != state {tshape}"
-                )
-            if isinstance(tmpl, jax.Array):
-                arr = arr.astype(tmpl.dtype)
-                # Re-apply mesh shardings (flat stage buffers etc.); leave
-                # single-device leaves UNCOMMITTED (jnp.asarray) — committing
-                # them to a fixed device would conflict with mesh-sharded
-                # siblings inside one jitted step.
-                if len(tmpl.sharding.device_set) > 1:
-                    new_leaves.append(jax.device_put(arr, tmpl.sharding))
-                else:
-                    new_leaves.append(jax.numpy.asarray(arr))
+        if isinstance(tmpl, jax.Array):
+            arr = arr.astype(tmpl.dtype)
+            # Re-apply mesh shardings (flat stage buffers etc.); leave
+            # single-device leaves UNCOMMITTED (jnp.asarray) — committing
+            # them to a fixed device would conflict with mesh-sharded
+            # siblings inside one jitted step.
+            if len(tmpl.sharding.device_set) > 1:
+                new_leaves.append(jax.device_put(arr, tmpl.sharding))
             else:
-                new_leaves.append(np.asarray(arr, np.asarray(tmpl).dtype))
+                new_leaves.append(jax.numpy.asarray(arr))
+        else:
+            new_leaves.append(np.asarray(arr, np.asarray(tmpl).dtype))
     return jax.tree.unflatten(treedef, new_leaves)
+
+
+def restore_state(path: str, template: Any,
+                  expected_fingerprint: Optional[str] = None) -> Any:
+    """Load leaves from `path` into the structure (and shardings) of
+    `template` after manifest validation."""
+    arrays, _ = load_arrays(path, expected_fingerprint)
+    return arrays_to_state(arrays, template)
 
 
 class CheckpointManager:
     """Numbered checkpoints in a directory: ckpt_<step>.npz, keep the newest
-    ``keep`` files."""
+    ``keep`` files.  ``fingerprint`` (from :func:`config_fingerprint`) is
+    stamped into every manifest and enforced on restore."""
 
-    def __init__(self, directory: str, keep: int = 3) -> None:
+    def __init__(self, directory: str, keep: int = 3,
+                 fingerprint: Optional[str] = None) -> None:
         self.directory = directory
         self.keep = keep
+        self.fingerprint = fingerprint
         os.makedirs(directory, exist_ok=True)
 
     def _all(self):
@@ -98,18 +283,57 @@ class CheckpointManager:
         all_ = self._all()
         return all_[-1][1] if all_ else None
 
-    def save(self, state: Any, step_id: int) -> str:
-        path = os.path.join(self.directory, f"ckpt_{step_id}.npz")
-        save_state(path, state, step_id)
+    def path_for(self, step_id: int) -> str:
+        return os.path.join(self.directory, f"ckpt_{step_id}.npz")
+
+    def save_arrays(self, arrays: Dict[str, np.ndarray], step_id: int) -> str:
+        """Write pre-gathered arrays (the writer-thread half of save)."""
+        path = self.path_for(step_id)
+        write_arrays(path, arrays, self.fingerprint)
         for _sid, p in self._all()[: -self.keep]:
             os.unlink(p)
         return path
 
-    def restore_latest(self, template: Any) -> Any:
-        path = self.latest_path()
-        if path is None:
-            return template
-        import logging
+    def save(self, state: Any, step_id: int) -> str:
+        return self.save_arrays(state_to_arrays(state, step_id), step_id)
 
-        logging.getLogger(__name__).info("restoring checkpoint %s", path)
-        return restore_state(path, template)
+    def restore_latest(self, template: Any,
+                       require: bool = False) -> Tuple[Any, int]:
+        """Restore the newest VALID checkpoint; returns ``(state, step_id)``.
+
+        Torn, corrupt, or fingerprint-mismatched files are skipped (with a
+        warning) in favor of the next-older one — a preemption mid-write or
+        a bad disk costs one checkpoint interval, not the run.  With no
+        valid checkpoint at all: returns ``(template, 0)`` — a fresh start
+        — unless ``require=True``, which raises :class:`CheckpointInvalid`
+        instead (for callers like anomaly rollback, where ``template`` is a
+        corrupted live state that must NOT be silently handed back).
+
+        Exception: when every file is invalid and at least one failed with
+        :class:`CheckpointMismatch` (wrong fingerprint/leaves — a different
+        program, deterministic user error), that mismatch is raised even
+        with ``require=False``: silently fresh-starting would then let the
+        new run's saves prune away the mismatched run's checkpoints."""
+        mismatch: Optional[CheckpointMismatch] = None
+        for _sid, path in reversed(self._all()):
+            try:
+                arrays, step_id = load_arrays(path, self.fingerprint)
+                state = arrays_to_state(arrays, template)
+            except CheckpointMismatch as e:
+                logger.warning("checkpoint from a different program %s: %s",
+                               path, e)
+                mismatch = mismatch or e
+                continue
+            except Exception as e:
+                logger.warning("skipping invalid checkpoint %s: %s", path, e)
+                continue
+            logger.info("restored checkpoint %s (step %d)", path, step_id)
+            return state, step_id
+        if mismatch is not None:
+            raise mismatch
+        if require:
+            raise CheckpointInvalid(
+                f"no valid checkpoint in {self.directory} "
+                f"({len(self._all())} file(s) present, all invalid)"
+            )
+        return template, 0

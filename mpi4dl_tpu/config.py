@@ -71,6 +71,24 @@ HATCHES: Dict[str, Hatch] = {
               "1 = disable obs trace scopes (jax.named_scope semantic names "
               "in traces/HLO) and host step annotations — pristine A/B "
               "compiles."),
+        Hatch("MPI4DL_FAULT", "<unset>",
+              "Deterministic fault injection: `<kind>@<step>[:arg]` with "
+              "kind in nan_loss|nan_batch|raise|sigterm|corrupt_ckpt|"
+              "stall_data — drives tests/test_resilience.py and the CI "
+              "kill-and-resume job (docs/resilience.md)."),
+        Hatch("MPI4DL_WATCHDOG_SECS", "0",
+              "Step watchdog wall-clock budget in seconds (0 = off): a step "
+              "(batch fetch + device step) exceeding it dumps live Python "
+              "stacks + the last RunLog record to stderr "
+              "(`--watchdog-secs` overrides)."),
+        Hatch("MPI4DL_NO_GUARD", "0",
+              "1 = disable the anomaly guard (per-step finite-loss check "
+              "with rollback to the last good checkpoint and poison-batch "
+              "skip)."),
+        Hatch("MPI4DL_GUARD_GRAD_NORM", "0",
+              "Grad-norm guard limit (float; 0 = off): a step reporting "
+              "metrics['grad_norm'] above it triggers the same rollback as "
+              "a non-finite loss."),
         Hatch("MPI4DL_TPU_TESTS", "0",
               "1 = opt in to real-TPU subprocess tests (the tunnel is slow "
               "and intermittently down)."),

@@ -13,7 +13,10 @@ from __future__ import annotations
 import dataclasses
 import math
 import os
-from typing import Iterator, Optional, Tuple
+import queue
+import threading
+import time
+from typing import Callable, Iterator, Optional, Tuple
 
 import numpy as np
 
@@ -206,3 +209,115 @@ def make_dataset(cfg):
 def iterate(dataset, batch_size: int, steps: int) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
     for i in range(steps):
         yield dataset.batch(i, batch_size)
+
+
+def fetch_batch_with_retry(dataset, idx: int, batch_size: int, *,
+                           retries: int = 2, backoff: float = 0.05,
+                           _sleep=time.sleep) -> Tuple[np.ndarray, np.ndarray]:
+    """``dataset.batch`` with bounded retry + exponential backoff around
+    transient I/O errors (``OSError``: NFS blips, eviction races in the
+    image-folder path), then fail-fast re-raising the ORIGINAL exception —
+    the ISSUE-3 replacement for the producer's single-shot raise.  Non-I/O
+    errors (bad shapes, logic bugs) propagate immediately: retrying those
+    only delays the crash."""
+    delay = backoff
+    first: Optional[OSError] = None
+    for remaining in range(retries, -1, -1):
+        try:
+            return dataset.batch(idx, batch_size)
+        except OSError as e:
+            if first is None:
+                first = e
+            if remaining == 0:
+                raise first
+            _sleep(delay)
+            delay *= 2.0
+    raise AssertionError("unreachable")  # loop always returns or raises
+
+
+def prefetch_batches(
+    dataset,
+    batch_size: int,
+    start: int,
+    stop: int,
+    *,
+    index_of: Optional[Callable[[int], int]] = None,
+    num_workers: int = 0,
+    retries: int = 2,
+    backoff: float = 0.05,
+    stall_hook: Optional[Callable[[int], float]] = None,
+) -> Iterator[Tuple[int, Tuple[np.ndarray, np.ndarray]]]:
+    """Yield ``(gstep, (x, y))`` for global steps in ``[start, stop)``;
+    the dataset index is ``index_of(gstep)`` (identity by default — the
+    supervised loop passes ``g % steps_per_epoch``).
+
+    ``num_workers > 0`` prefetches on a background thread (the reference's
+    DataLoader num_workers analog).  Early consumer exit (exception
+    mid-epoch, generator close, rollback reopening past a poison batch)
+    must not strand the producer: a plain ``q.put`` on a full queue would
+    block forever holding batch memory once nobody drains it.  The producer
+    therefore puts with a timeout while polling a stop event, and the
+    generator's ``finally`` sets the event and drains the queue so the
+    thread always terminates.  A producer-side exception rides the queue as
+    a sentinel and re-raises in the consumer — a dead producer must not
+    leave the consumer blocked on ``q.get()``.
+
+    ``stall_hook(gstep)`` (fault injection) returns seconds to sleep before
+    producing that batch — the watchdog's test stimulus.
+    """
+    idx_of = index_of if index_of is not None else (lambda g: g)
+
+    def fetch(g: int) -> Tuple[np.ndarray, np.ndarray]:
+        if stall_hook is not None:
+            delay = stall_hook(g)
+            if delay:
+                time.sleep(delay)
+        return fetch_batch_with_retry(
+            dataset, idx_of(g), batch_size, retries=retries, backoff=backoff
+        )
+
+    if num_workers <= 0:
+        for g in range(start, stop):
+            yield g, fetch(g)
+        return
+
+    q: queue.Queue = queue.Queue(maxsize=max(2, num_workers))
+    stop_evt = threading.Event()
+
+    def _put(item) -> bool:
+        while not stop_evt.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def producer():
+        try:
+            for g in range(start, stop):
+                if stop_evt.is_set() or not _put((g, fetch(g))):
+                    return
+        except BaseException as e:  # noqa: BLE001 — forwarded to consumer
+            _put(e)
+            return
+        _put(None)  # end-of-stream sentinel
+
+    t = threading.Thread(target=producer, daemon=True, name="mpi4dl-batches")
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop_evt.set()
+        while True:  # unblock a producer waiting on a full queue
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+        t.join(timeout=5.0)

@@ -91,6 +91,21 @@ def render_run(path: str) -> str:
         med = None
         lines.append(f"steps: 0 measured, {warmup} warmup dropped")
 
+    # -- resilience events (docs/resilience.md) ----------------------------
+    events = [r for r in records
+              if r.get("kind") in ("anomaly", "recovery", "preempt")]
+    if events:
+        parts = []
+        for r in events:
+            at = r.get("gstep", r.get("skipped_step"))
+            extra = ""
+            if r["kind"] == "anomaly":
+                extra = f" ({r.get('reason')})"
+            elif r["kind"] == "recovery":
+                extra = f" (resumed from {r.get('resumed_from')})"
+            parts.append(f"{r['kind']}@{at}{extra}")
+        lines.append("resilience events: " + "; ".join(parts))
+
     # -- memory watermark --------------------------------------------------
     dev_peaks = [r.get("memory_peak_bytes") for r in steps
                  if r.get("memory_peak_bytes") is not None]

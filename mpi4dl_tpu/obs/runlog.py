@@ -41,8 +41,8 @@ def _jsonable(obj: Any) -> Any:
     if hasattr(obj, "item"):  # numpy / jax scalars
         try:
             return obj.item()
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception:  # noqa: BLE001  # analysis: ok(swallow-except)
+            pass  # deliberate: falls through to the repr() fallback below
     return repr(obj)
 
 
@@ -105,6 +105,9 @@ class RunLog:
 
     def __init__(self, path: str):
         self.path = path
+        # Most recent record written (any kind) — the step watchdog dumps it
+        # to stderr alongside live stacks when a step blows its budget.
+        self.last_record: Optional[Dict[str, Any]] = None
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         self._fh = open(path, "a", encoding="utf-8")
 
@@ -128,6 +131,7 @@ class RunLog:
         rec.update({k: _jsonable(v) for k, v in fields.items()})
         self._fh.write(json.dumps(rec) + "\n")
         self._fh.flush()
+        self.last_record = rec
         return rec
 
     def write_meta(self, config: Any = None, mesh_spec: Any = None,

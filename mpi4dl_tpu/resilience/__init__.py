@@ -1,0 +1,68 @@
+"""Resilience subsystem: make training runs survive what obs/ observes.
+
+ISSUE 3 — the reference MPI4DL stack has no fault tolerance at all (SURVEY
+§5): no checkpointing, no recovery; a single NaN or a preempted rank kills
+a multi-day pathology run.  This package turns the existing pieces
+(checkpoint.py durability, obs/ telemetry) into a crash-survivable trainer:
+
+- :mod:`~mpi4dl_tpu.resilience.loop` — ``run_supervised``: the one
+  supervised training loop all four engine families (lp / sp / gems /
+  gems_sp) run under.
+- :mod:`~mpi4dl_tpu.resilience.guard` — per-step finite-loss (and opt-in
+  grad-norm) check; on anomaly the loop rolls back to the last good
+  checkpoint and skips the poison batch.
+- :mod:`~mpi4dl_tpu.resilience.preempt` — SIGTERM/SIGINT → finish the
+  in-flight step, save, exit 0.
+- :mod:`~mpi4dl_tpu.resilience.writer` — background checkpoint writes
+  (device_get on the training thread, serialize+fsync off it).
+- :mod:`~mpi4dl_tpu.resilience.faults` — deterministic fault injection via
+  ``MPI4DL_FAULT=<kind>@<step>[:arg]`` — powers tests and the CI
+  kill-and-resume job.
+- :mod:`~mpi4dl_tpu.resilience.watchdog` — step wall-clock watchdog that
+  dumps live stacks + the last RunLog record before a hang dies silently.
+
+Event schema, fault kinds, manifest format, recovery semantics:
+docs/resilience.md.
+"""
+
+from __future__ import annotations
+
+from mpi4dl_tpu.resilience.faults import (
+    FAULT_KINDS,
+    FaultInjected,
+    FaultInjector,
+    FaultSpec,
+    corrupt_file,
+    fault_from_env,
+    parse_fault,
+)
+from mpi4dl_tpu.resilience.guard import AnomalyError, AnomalyGuard, global_norm
+from mpi4dl_tpu.resilience.loop import LoopResult, run_supervised
+from mpi4dl_tpu.resilience.preempt import PreemptionHandler
+from mpi4dl_tpu.resilience.watchdog import (
+    StepWatchdog,
+    dump_stacks,
+    watchdog_budget_from_env,
+)
+from mpi4dl_tpu.resilience.writer import AsyncCheckpointWriter, CheckpointWriteError
+
+__all__ = [
+    "FAULT_KINDS",
+    "AnomalyError",
+    "AnomalyGuard",
+    "AsyncCheckpointWriter",
+    "CheckpointWriteError",
+    "FaultInjected",
+    "FaultInjector",
+    "FaultSpec",
+    "LoopResult",
+    "PreemptionHandler",
+    "StepWatchdog",
+    "corrupt_file",
+    "dump_stacks",
+    "fault_from_env",
+    "global_norm",
+    "parse_fault",
+    "run_supervised",
+    "watchdog_budget_from_env",
+]

@@ -1,0 +1,142 @@
+"""Deterministic fault injection (ISSUE 3 component 3).
+
+One hatch drives everything: ``MPI4DL_FAULT=<kind>@<step>[:arg]`` (declared
+in ``config.HATCHES``).  The supervised loop calls the injector at fixed,
+documented points, so a fault fires at exactly one global step and the same
+spec reproduces the same failure in pytest, in the CI kill-and-resume job,
+and in a by-hand run.  Kinds:
+
+=================  ==========================================================
+``nan_loss``       replace the observed loss at step k with NaN (guard path
+                   without touching device state)
+``nan_batch``      poison the input batch at step k with NaN (device state
+                   genuinely corrupts — the full rollback path)
+``raise``          raise :class:`FaultInjected` before step k (crash path)
+``sigterm``        deliver SIGTERM to this process before step k (preemption
+                   path: finish the step, checkpoint, exit 0)
+``corrupt_ckpt``   flip bytes mid-file in the first checkpoint written at or
+                   after step k (restore must fall back to an older file)
+``stall_data``     the data producer sleeps ``arg`` seconds (default 2.0)
+                   before batch k (watchdog path)
+=================  ==========================================================
+
+Every injector fires at most once per process — deterministic single-shot
+semantics, so "exactly one rollback" is a meaningful assertion.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+from typing import Any, Optional
+
+FAULT_KINDS = (
+    "nan_loss", "nan_batch", "raise", "sigterm", "corrupt_ckpt", "stall_data",
+)
+
+
+class FaultInjected(RuntimeError):
+    """The injected crash for ``MPI4DL_FAULT=raise@<step>``."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    kind: str
+    step: int
+    arg: float = 0.0
+
+
+def parse_fault(text: Optional[str]) -> Optional[FaultSpec]:
+    """Parse ``<kind>@<step>[:arg]``; empty/None means no fault."""
+    if not text:
+        return None
+    head, _, arg = text.partition(":")
+    kind, sep, step = head.partition("@")
+    if kind not in FAULT_KINDS or not sep or not step.lstrip("-").isdigit():
+        raise ValueError(
+            f"MPI4DL_FAULT={text!r}: expected <kind>@<step>[:arg] with kind "
+            f"in {FAULT_KINDS}"
+        )
+    return FaultSpec(kind, int(step), float(arg) if arg else 0.0)
+
+
+def fault_from_env() -> Optional[FaultSpec]:
+    return parse_fault(os.environ.get("MPI4DL_FAULT", ""))
+
+
+def corrupt_file(path: str, nbytes: int = 64) -> None:
+    """Flip ``nbytes`` in the middle of ``path`` — simulates on-disk
+    corruption the zip layer may not even notice (the manifest CRC does)."""
+    size = os.path.getsize(path)
+    off = size // 2
+    with open(path, "r+b") as f:
+        f.seek(off)
+        chunk = f.read(min(nbytes, max(size - off, 1)))
+        f.seek(off)
+        f.write(bytes((~b) & 0xFF for b in chunk))
+        f.flush()
+        os.fsync(f.fileno())
+
+
+class FaultInjector:
+    """Single-shot injectors for the supervised loop's fixed points."""
+
+    def __init__(self, spec: Optional[FaultSpec] = None):
+        self.spec = spec
+        self.fired = False
+
+    @classmethod
+    def from_env(cls) -> "FaultInjector":
+        return cls(fault_from_env())
+
+    def _fire(self, kind: str, gstep: int) -> bool:
+        if self.spec is None or self.fired:
+            return False
+        if self.spec.kind != kind or gstep != self.spec.step:
+            return False
+        self.fired = True
+        return True
+
+    # -- loop hook points --------------------------------------------------
+
+    def before_step(self, gstep: int) -> None:
+        """Crash/preemption faults, delivered before the step runs."""
+        if self._fire("raise", gstep):
+            raise FaultInjected(f"injected crash before step {gstep}")
+        if self._fire("sigterm", gstep):
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    def poison_batch(self, gstep: int, x: Any) -> Any:
+        if self._fire("nan_batch", gstep):
+            import numpy as np
+
+            x = np.asarray(x).copy()
+            x[...] = np.nan
+        return x
+
+    def poison_loss(self, gstep: int, loss: float) -> float:
+        if self._fire("nan_loss", gstep):
+            return float("nan")
+        return loss
+
+    def after_save(self, step_id: int, path: Optional[str]) -> None:
+        """``corrupt_ckpt``: fires on the first save at or after the spec
+        step (saves land on epoch boundaries, not every step)."""
+        if (
+            self.spec is not None
+            and self.spec.kind == "corrupt_ckpt"
+            and not self.fired
+            and step_id >= self.spec.step
+            and path is not None
+            and os.path.exists(path)
+        ):
+            self.fired = True
+            corrupt_file(path)
+
+    def stall_seconds(self, gstep: int) -> float:
+        """Called by the data producer for each batch index; nonzero means
+        sleep that long before producing (the watchdog's test stimulus)."""
+        if self._fire("stall_data", gstep):
+            return self.spec.arg or 2.0
+        return 0.0

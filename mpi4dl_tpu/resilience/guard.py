@@ -1,0 +1,94 @@
+"""Anomaly guard: the per-step numerical tripwire (ISSUE 3 component 1).
+
+The reference stack has no fault tolerance at all — a single NaN (poison
+batch, bf16 overflow, flaky interconnect bit) kills a multi-day pathology
+run.  The guard checks every step's loss for finiteness (and, opt-in, the
+reported grad norm against a limit); on a hit the supervised loop rolls
+state back to the last good checkpoint, skips past the poison batch, and
+records ``anomaly``/``recovery`` events in the RunLog
+(:mod:`mpi4dl_tpu.resilience.loop` owns the rollback mechanics — the guard
+only detects and counts).
+
+Hatches (``config.HATCHES``): ``MPI4DL_NO_GUARD=1`` disables the guard;
+``MPI4DL_GUARD_GRAD_NORM=<float>`` arms the grad-norm check for step
+functions that report ``metrics['grad_norm']`` (none do by default — the
+check is opt-in on both sides; :func:`global_norm` is the helper a step
+builder would use to emit it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+from typing import Any, Dict, Optional
+
+
+class AnomalyError(RuntimeError):
+    """Raised when anomalies persist past ``max_rollbacks`` — the data or
+    the program is systematically poisoned; restarting is not recovery."""
+
+
+def global_norm(tree: Any):
+    """L2 norm over every leaf of a pytree (fp32 accumulation) — the value a
+    step builder emits as ``metrics['grad_norm']`` to arm the opt-in check."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return jnp.float32(0.0)
+    return jnp.sqrt(
+        sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+    )
+
+
+@dataclasses.dataclass
+class AnomalyGuard:
+    """Detects per-step numerical anomalies; the loop performs the rollback.
+
+    ``check`` returns a human-readable reason string (anomaly) or ``None``
+    (step is good).  ``note_rollback`` counts recoveries and raises
+    :class:`AnomalyError` once ``max_rollbacks`` is exceeded — a run that
+    keeps tripping is not transient and must fail loudly.
+    """
+
+    grad_norm_limit: float = 0.0  # 0 = grad-norm check off
+    max_rollbacks: int = 3
+    rollbacks: int = 0
+
+    @classmethod
+    def from_env(cls) -> Optional["AnomalyGuard"]:
+        """The default-on construction: ``None`` only under
+        ``MPI4DL_NO_GUARD=1``; grad-norm limit from
+        ``MPI4DL_GUARD_GRAD_NORM``."""
+        if os.environ.get("MPI4DL_NO_GUARD", "0") == "1":
+            return None
+        limit = float(os.environ.get("MPI4DL_GUARD_GRAD_NORM", "0") or 0.0)
+        return cls(grad_norm_limit=limit)
+
+    def check(self, loss: float,
+              metrics: Optional[Dict[str, Any]] = None) -> Optional[str]:
+        if not math.isfinite(loss):
+            return f"non-finite loss {loss}"
+        if self.grad_norm_limit > 0 and metrics is not None:
+            gn = metrics.get("grad_norm")
+            if gn is not None:
+                gn = float(gn)
+                if not math.isfinite(gn):
+                    return f"non-finite grad norm {gn}"
+                if gn > self.grad_norm_limit:
+                    return (
+                        f"grad norm {gn:.4g} exceeds limit "
+                        f"{self.grad_norm_limit:.4g}"
+                    )
+        return None
+
+    def note_rollback(self) -> None:
+        self.rollbacks += 1
+        if self.rollbacks > self.max_rollbacks:
+            raise AnomalyError(
+                f"{self.rollbacks} rollbacks exceed max_rollbacks="
+                f"{self.max_rollbacks}: anomalies are persistent, not "
+                "transient — failing fast"
+            )

@@ -1,0 +1,301 @@
+"""The supervised training loop — every benchmark family runs under it.
+
+This re-homes the epoch loop from ``benchmarks/common.py`` into the library
+and wraps it with the resilience layer (ISSUE 3): anomaly guard with
+checkpoint rollback, preemption-safe shutdown, background checkpoint
+writes, deterministic fault injection, and the step watchdog.  The loop is
+engine-agnostic — lp / sp / gems / gems_sp all present the same
+``step(state, x, y) -> (state, metrics)`` contract, so one supervisor
+covers all four.
+
+Step addressing is GLOBAL: ``gstep`` counts optimizer steps across epochs,
+the dataset index is ``gstep % steps_per_epoch`` (each epoch replays the
+same deterministic batch indices, matching the pre-existing benchmark
+semantics), and checkpoints are numbered by completed-step count — so a
+resume at ``step_id`` continues the exact batch sequence instead of
+restarting at 0 (the PR-3 satellite fix: ``restore_latest`` now returns the
+step id it discarded before).
+
+Event records written to the RunLog (see docs/resilience.md):
+
+- ``anomaly``  — guard tripped (non-finite loss / grad-norm breach)
+- ``recovery`` — state rolled back; the poison batch is skipped
+- ``preempt``  — SIGTERM/SIGINT honored: in-flight step finished, state
+  saved, loop exited cleanly
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import math
+from typing import Any, Callable, Dict, Optional
+
+from mpi4dl_tpu.checkpoint import CheckpointManager, arrays_to_state, state_to_arrays
+from mpi4dl_tpu.data import prefetch_batches
+from mpi4dl_tpu.resilience.faults import FaultInjector
+from mpi4dl_tpu.resilience.guard import AnomalyError, AnomalyGuard
+from mpi4dl_tpu.resilience.preempt import PreemptionHandler
+from mpi4dl_tpu.resilience.watchdog import StepWatchdog
+from mpi4dl_tpu.resilience.writer import AsyncCheckpointWriter
+from mpi4dl_tpu.utils import Timer
+
+_NULL_CTX = contextlib.nullcontext()
+
+
+@dataclasses.dataclass
+class LoopResult:
+    state: Any
+    metrics: Dict[str, float]  # last completed step's {loss, accuracy}
+    steps_run: int  # steps completed by THIS process
+    final_step: int  # global step count after the loop (resume point)
+    preempted: bool
+    anomalies: int
+
+
+def run_supervised(
+    step_fn: Callable,
+    state: Any,
+    dataset: Any,
+    *,
+    global_batch: int,
+    steps_per_epoch: int,
+    num_epochs: int = 1,
+    num_workers: int = 0,
+    start_step: int = 0,
+    ckpt: Optional[CheckpointManager] = None,
+    async_writes: bool = True,
+    runlog=None,
+    meter=None,
+    print_fn: Optional[Callable[[str], None]] = None,
+    profile: bool = False,
+    guard: Optional[AnomalyGuard] = None,
+    faults: Optional[FaultInjector] = None,
+    watchdog_secs: float = 0.0,
+    handle_signals: bool = True,
+    retries: int = 2,
+    retry_backoff: float = 0.05,
+    snapshot_rollback: bool = False,
+) -> LoopResult:
+    """Run ``steps_per_epoch * num_epochs`` supervised steps from
+    ``start_step``; returns the final state plus what happened.
+
+    Checkpoint cadence: a guard baseline before the first step when the
+    directory is empty, every epoch boundary, and on preemption — all
+    through the background writer (``async_writes=False`` forces the
+    synchronous path).  Without ``ckpt``, the guard is DETECTION-ONLY: an
+    anomaly raises :class:`AnomalyError` after logging (fail fast beats
+    both silent NaN training and an implicit full-state host copy) —
+    unless ``snapshot_rollback=True``, which opts into an in-memory host
+    snapshot refreshed at the checkpoint cadence (costs a full extra copy
+    of the training state in host RAM; fine for tests/small models, not
+    for pathology-scale stage buffers).
+    """
+    emit = print_fn if print_fn is not None else (lambda line: None)
+    faults = faults if faults is not None else FaultInjector(None)
+    timer = Timer()
+    total = steps_per_epoch * num_epochs
+    gstep = start_step
+    metrics_out: Dict[str, float] = {}
+    anomalies = 0
+    preempted = False
+    steps_run = 0
+
+    writer = (
+        AsyncCheckpointWriter(ckpt) if (ckpt is not None and async_writes)
+        else None
+    )
+
+    def _save(st: Any, step_id: int) -> Optional[str]:
+        if ckpt is None:
+            return None
+        path = writer.save(st, step_id) if writer else ckpt.save(st, step_id)
+        if faults.spec is not None and faults.spec.kind == "corrupt_ckpt":
+            if writer is not None:
+                writer.flush()  # the fault corrupts a file, not a queue entry
+            faults.after_save(step_id, path)
+        return path
+
+    # Rollback target: newest on-disk checkpoint, else (opt-in) an
+    # in-memory host snapshot (host copies are mandatory either way —
+    # donation invalidates the device buffers the moment the next step
+    # runs).  No ckpt and no opt-in = detection-only guard.
+    snapshot = None
+    if guard is not None:
+        if ckpt is not None:
+            if ckpt.latest_path() is None:
+                _save(state, gstep)
+        elif snapshot_rollback:
+            snapshot = (state_to_arrays(state, gstep), gstep)
+
+    def _boundary_save(st: Any, step_id: int) -> None:
+        """Epoch-boundary persistence — one policy for the normal path and
+        the rollback-jumped-the-boundary path (incl. step_id == total: the
+        final state must persist or a resume replays the tail forever)."""
+        nonlocal snapshot
+        if ckpt is not None:
+            _save(st, step_id)
+        elif snapshot is not None:
+            snapshot = (state_to_arrays(st, step_id), step_id)
+
+    from mpi4dl_tpu.obs import step_annotation  # deferred: pulls in jax
+
+    def _last_record():
+        return getattr(runlog, "last_record", None) if runlog is not None else None
+
+    watchdog = StepWatchdog(watchdog_secs, get_context=_last_record)
+    preempt = (
+        PreemptionHandler() if handle_signals else PreemptionHandler(())
+    )
+
+    def _preempt_exit(st: Any, step_id: int) -> None:
+        saved = _save(st, step_id) is not None
+        if writer is not None:
+            writer.flush()  # "saved" must mean durable before exiting
+        if runlog is not None:
+            runlog.write("preempt", gstep=step_id, signum=preempt.signum,
+                         saved=saved)
+        emit(
+            f"preemption signal {preempt.signum} — "
+            + (f"checkpoint saved at step {step_id}"
+               if saved else
+               f"NO checkpoint dir configured, step-{step_id} progress is "
+               "not resumable")
+            + "; exiting cleanly"
+        )
+
+    try:
+        with preempt, watchdog:
+            while gstep < total and not preempted:
+                # One contiguous segment of the batch stream; a rollback
+                # closes it and reopens past the poison batch.
+                segment = prefetch_batches(
+                    dataset, global_batch, gstep, total,
+                    index_of=lambda g: g % steps_per_epoch,
+                    num_workers=num_workers, retries=retries,
+                    backoff=retry_backoff, stall_hook=faults.stall_seconds,
+                )
+                rollback_to = None
+                try:
+                    while True:
+                        # Arm BEFORE the fetch: a stalled producer is
+                        # exactly the hang the watchdog exists for.
+                        watchdog.arm(f"step {gstep}")
+                        try:
+                            g, (x, y) = next(segment)
+                        except StopIteration:
+                            watchdog.disarm()
+                            break
+                        # A signal that landed during the fetch must not pay
+                        # for a whole extra step before being honored — the
+                        # grace window may not cover it.  `gstep` steps are
+                        # complete; the just-fetched batch is simply dropped.
+                        if preempt.requested:
+                            watchdog.disarm()
+                            _preempt_exit(state, gstep)
+                            preempted = True
+                            break
+                        epoch, i = divmod(g, steps_per_epoch)
+                        faults.before_step(g)
+                        x = faults.poison_batch(g, x)
+                        timer.start()
+                        with step_annotation(g) if profile else _NULL_CTX:
+                            state, metrics = step_fn(state, x, y)
+                            loss = float(metrics["loss"])  # blocks on device
+                        ms = timer.stop()
+                        watchdog.disarm()
+                        loss = faults.poison_loss(g, loss)
+
+                        reason = (
+                            guard.check(loss, metrics)
+                            if guard is not None else None
+                        )
+                        if reason is not None:
+                            anomalies += 1
+                            if runlog is not None:
+                                runlog.write(
+                                    "anomaly", gstep=g, epoch=epoch, step=i,
+                                    loss=loss, reason=reason,
+                                )
+                            emit(f"anomaly at step {g}: {reason}")
+                            if ckpt is None and snapshot is None:
+                                # detection-only: no rollback target exists
+                                # (and silently continuing would train on a
+                                # possibly-poisoned state)
+                                raise AnomalyError(
+                                    f"anomaly at step {g} ({reason}) with no "
+                                    "rollback target — pass a checkpoint "
+                                    "directory (or snapshot_rollback=True) "
+                                    "to recover instead of failing fast"
+                                )
+                            guard.note_rollback()  # raises when exhausted
+                            if ckpt is not None:
+                                if writer is not None:
+                                    writer.flush()
+                                # require=True: with every on-disk file
+                                # invalid, handing back the live (possibly
+                                # NaN-poisoned) template as a "recovery"
+                                # would keep training on corrupt weights —
+                                # fail loudly instead.
+                                state, good = ckpt.restore_latest(
+                                    state, require=True
+                                )
+                            else:
+                                arrays, good = snapshot
+                                state = arrays_to_state(arrays, state)
+                            if runlog is not None:
+                                runlog.write(
+                                    "recovery", resumed_from=good,
+                                    skipped_step=g, next_step=g + 1,
+                                )
+                            emit(
+                                f"rolled back to step {good}; skipping "
+                                f"poison batch {g}"
+                            )
+                            rollback_to = g + 1
+                            break
+
+                        measured = meter.add(ms) if meter is not None else True
+                        acc = float(metrics.get("accuracy", math.nan))
+                        metrics_out = {"loss": loss, "accuracy": acc}
+                        emit(
+                            f"epoch {epoch} step {i} time_ms {ms:.1f} "
+                            f"images_per_sec {global_batch / (ms / 1e3):.3f} "
+                            f"loss {loss:.4f} acc {acc:.4f}"
+                        )
+                        if runlog is not None:
+                            runlog.write_step(
+                                epoch=epoch, step=i, ms=ms,
+                                images_per_sec=global_batch / (ms / 1e3),
+                                loss=loss, accuracy=acc, step_fn=step_fn,
+                                measured=measured, gstep=g,
+                            )
+                        gstep = g + 1
+                        steps_run += 1
+
+                        if preempt.requested:
+                            _preempt_exit(state, gstep)
+                            preempted = True
+                            break
+                        if gstep % steps_per_epoch == 0:
+                            _boundary_save(state, gstep)
+                finally:
+                    segment.close()
+                if rollback_to is not None:
+                    gstep = rollback_to
+                    # A skipped poison batch can jump PAST an epoch boundary
+                    # (or land on the very last step): the boundary save
+                    # must still happen, or the rollback target silently
+                    # ages — and a final-step rollback would leave nothing
+                    # newer than the baseline, so every resume re-trains the
+                    # whole run just to re-skip the same poison batch.
+                    if gstep % steps_per_epoch == 0:
+                        _boundary_save(state, gstep)
+    finally:
+        if writer is not None:
+            writer.close()
+
+    return LoopResult(
+        state=state, metrics=metrics_out, steps_run=steps_run,
+        final_step=gstep, preempted=preempted, anomalies=anomalies,
+    )

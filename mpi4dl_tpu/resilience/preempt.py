@@ -1,0 +1,62 @@
+"""Preemption-safe shutdown (ISSUE 3 component 2, signal half).
+
+TPU preemption (and any batch scheduler worth the name) delivers SIGTERM
+with a grace window.  The handler here only sets a flag; the supervised
+loop checks it AFTER each completed step, saves a checkpoint, and returns
+cleanly — so the process finishes the in-flight step, persists, and exits
+0 instead of dying mid-write.  A second signal restores the original
+disposition and re-raises it: an operator mashing Ctrl-C (or a scheduler
+escalating) still gets an immediate kill.
+
+Installation degrades gracefully off the main thread (``signal.signal``
+raises there): the loop simply runs unsupervised — important for pytest
+workers and embedded use.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+from typing import Dict, Optional, Tuple
+
+
+class PreemptionHandler:
+    """Context manager latching SIGTERM/SIGINT into a ``requested`` flag."""
+
+    def __init__(self, signums: Tuple[int, ...] = (signal.SIGTERM, signal.SIGINT)):
+        self.signums = tuple(signums)
+        self.requested = False
+        self.signum: Optional[int] = None
+        self.active = False
+        self._old: Dict[int, object] = {}
+
+    def __enter__(self) -> "PreemptionHandler":
+        try:
+            for s in self.signums:
+                self._old[s] = signal.signal(s, self._handle)
+            self.active = bool(self.signums)
+        except ValueError:  # not the main thread — run without the net
+            self._restore()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._restore()
+
+    def _handle(self, signum, frame) -> None:
+        if self.requested:
+            # Second signal: give the signal its original meaning back and
+            # redeliver — escalation must still kill a wedged process.
+            self._restore()
+            os.kill(os.getpid(), signum)
+            return
+        self.requested = True
+        self.signum = signum
+
+    def _restore(self) -> None:
+        for s, h in self._old.items():
+            try:
+                signal.signal(s, h)
+            except (ValueError, OSError):
+                continue  # torn down off-thread / at interpreter exit
+        self._old = {}
+        self.active = False

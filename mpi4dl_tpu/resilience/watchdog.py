@@ -1,0 +1,129 @@
+"""Step watchdog (ISSUE 3 component 4): evidence before the silent death.
+
+A wedged collective, a stuck data producer, or a host-side deadlock makes a
+training job hang until the scheduler kills it — with nothing on stderr to
+debug from.  The watchdog is a monitor thread the supervised loop arms at
+the start of each step (covering the batch fetch AND the device step) and
+disarms after; if the armed deadline passes, it dumps every live Python
+thread's stack plus the last RunLog record to stderr, once per armed step,
+and keeps monitoring.  It never kills the job — it makes the eventual death
+diagnosable.
+
+Budget resolution: the ``--watchdog-secs`` flag, else the
+``MPI4DL_WATCHDOG_SECS`` hatch, else 0 (off).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, Optional
+
+
+def watchdog_budget_from_env(flag_value: Optional[float] = None) -> float:
+    """Resolve the step budget: explicit flag wins, then the hatch, then 0."""
+    if flag_value is not None:
+        return float(flag_value)
+    return float(os.environ.get("MPI4DL_WATCHDOG_SECS", "0") or 0.0)
+
+
+def dump_stacks(out) -> None:
+    """Write every live Python thread's stack to ``out`` (named by thread)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    for ident, frame in sys._current_frames().items():
+        out.write(f"--- thread {names.get(ident, '?')} (ident {ident}) ---\n")
+        out.write("".join(traceback.format_stack(frame)))
+
+
+class StepWatchdog:
+    """Monitor thread firing a stderr diagnostic when an armed step exceeds
+    ``budget_secs``.  ``budget_secs <= 0`` disables everything (``start``
+    spawns no thread; ``arm``/``disarm`` are no-ops)."""
+
+    def __init__(self, budget_secs: float,
+                 get_context: Optional[Callable[[], object]] = None,
+                 out=None):
+        self.budget = float(budget_secs)
+        self.get_context = get_context
+        self.out = out  # None = sys.stderr at fire time (test-friendly)
+        self.fired = 0
+        self._deadline: Optional[float] = None
+        self._label = ""
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "StepWatchdog":
+        if self.budget > 0 and self._thread is None:
+            self._thread = threading.Thread(
+                target=self._monitor, name="mpi4dl-watchdog", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self) -> "StepWatchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- arming ------------------------------------------------------------
+
+    def arm(self, label: str = "") -> None:
+        if self.budget <= 0:
+            return
+        with self._lock:
+            self._label = label
+            self._deadline = time.monotonic() + self.budget
+
+    def disarm(self) -> None:
+        if self.budget <= 0:
+            return
+        with self._lock:
+            self._deadline = None
+
+    # -- monitor -----------------------------------------------------------
+
+    def _monitor(self) -> None:
+        poll = max(min(self.budget / 4.0, 0.25), 0.01)
+        while not self._stop.wait(poll):
+            with self._lock:
+                deadline, label = self._deadline, self._label
+            if deadline is not None and time.monotonic() > deadline:
+                self._dump(label)
+                with self._lock:
+                    # fire once per armed step; a re-arm resets the deadline
+                    if self._deadline == deadline:
+                        self._deadline = None
+
+    def _dump(self, label: str) -> None:
+        self.fired += 1
+        out = self.out if self.out is not None else sys.stderr
+        out.write(
+            f"\n=== mpi4dl_tpu watchdog: {label or 'step'} exceeded the "
+            f"{self.budget:.1f}s wall-clock budget ===\n"
+        )
+        if self.get_context is not None:
+            try:
+                ctx = self.get_context()
+            except Exception as e:
+                ctx = f"<context unavailable: {e!r}>"
+            if ctx is not None:
+                rendered = (
+                    json.dumps(ctx) if isinstance(ctx, dict) else str(ctx)
+                )
+                out.write(f"last runlog record: {rendered}\n")
+        dump_stacks(out)
+        out.flush()

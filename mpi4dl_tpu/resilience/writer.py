@@ -1,0 +1,96 @@
+"""Background checkpoint writer (ISSUE 3 component 2, I/O half).
+
+A synchronous ``CheckpointManager.save`` stalls the training loop for the
+full serialize+fsync of every leaf — at pathology scales (ResNet@2k-8k
+inputs, flat stage buffers) that is seconds per save on network disks.  The
+split: ``jax.device_get`` MUST happen on the training thread (the very next
+step donates the state buffers), but npz serialization, fsync, and the
+atomic rename are pure host I/O — they move to one worker thread with a
+small bounded queue.
+
+Failure semantics: a worker-side error is latched and re-raised on the NEXT
+``save``/``flush``/``close`` on the training thread — checkpoint loss must
+fail the run loudly, never silently.  ``flush()`` blocks until every queued
+write hit disk (the loop calls it before restore-for-rollback and before a
+preemption exit, so "saved" always means durable at those points).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Optional
+
+from mpi4dl_tpu.checkpoint import CheckpointManager, state_to_arrays
+
+
+class CheckpointWriteError(RuntimeError):
+    """A background checkpoint write failed (original error chained)."""
+
+
+class AsyncCheckpointWriter:
+    """Two-phase async saves over a :class:`CheckpointManager`."""
+
+    _SENTINEL = object()
+
+    def __init__(self, manager: CheckpointManager, max_pending: int = 2):
+        self.manager = manager
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, max_pending))
+        self._error: Optional[BaseException] = None
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._worker, name="mpi4dl-ckpt-writer", daemon=True
+        )
+        self._thread.start()
+
+    def save(self, state: Any, step_id: int) -> str:
+        """Gather on the calling thread, enqueue the write; returns the
+        path the checkpoint WILL land at.  Blocks only when ``max_pending``
+        writes are already in flight (backpressure beats unbounded RAM)."""
+        self._check()
+        if self._closed:
+            raise CheckpointWriteError("writer is closed")
+        arrays = state_to_arrays(state, step_id)
+        self._q.put((arrays, step_id))
+        return self.manager.path_for(step_id)
+
+    def flush(self) -> None:
+        """Block until every queued write is durable; raise on any failure."""
+        self._q.join()
+        self._check()
+
+    def close(self) -> None:
+        """Drain, stop the worker, surface any pending error."""
+        if not self._closed:
+            self._closed = True
+            self._q.put(self._SENTINEL)
+            self._thread.join(timeout=60.0)
+        self._check()
+
+    def __enter__(self) -> "AsyncCheckpointWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- internals ---------------------------------------------------------
+
+    def _worker(self) -> None:
+        while True:
+            item = self._q.get()
+            try:
+                if item is self._SENTINEL:
+                    return
+                arrays, step_id = item
+                self.manager.save_arrays(arrays, step_id)
+            except BaseException as e:  # latched for the training thread
+                self._error = e
+            finally:
+                self._q.task_done()
+
+    def _check(self) -> None:
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise CheckpointWriteError(
+                f"background checkpoint write failed: {err!r}"
+            ) from err

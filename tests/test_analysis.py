@@ -469,7 +469,7 @@ def test_cli_exit_codes(tmp_path):
     )
     assert r.returncode == 0
     for name in ("collective-axis", "tracer-leak", "dtype-policy",
-                 "env-hatch", "retrace", "print-call"):
+                 "env-hatch", "retrace", "print-call", "swallow-except"):
         assert name in r.stdout
 
 
@@ -538,5 +538,106 @@ def test_print_call_shadowed_print_not_flagged(tmp_path):
             print("not the builtin")
         """,
         rule="print-call",
+    )
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# (8) swallow-except
+# ---------------------------------------------------------------------------
+
+
+def test_swallow_except_bare_positive(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        def f():
+            try:
+                risky()
+            except:
+                recover()
+        """,
+        rule="swallow-except",
+    )
+    assert len(vs) == 1 and "bare" in vs[0].message
+
+
+def test_swallow_except_exception_pass_positive(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        def f():
+            try:
+                risky()
+            except Exception:
+                pass
+            try:
+                risky()
+            except (ValueError, BaseException) as e:
+                ...
+        """,
+        rule="swallow-except",
+    )
+    assert len(vs) == 2
+
+
+def test_swallow_except_handled_negative(tmp_path):
+    """Narrow types, logged/handled broad catches, and re-raises are all
+    deliberate — only SILENT broad swallows are flagged."""
+    vs = _run(
+        tmp_path,
+        """
+        import logging
+
+        def f():
+            try:
+                risky()
+            except OSError:
+                pass  # narrow type: an explicit decision
+            try:
+                risky()
+            except Exception as e:
+                logging.warning("recovering: %s", e)
+            try:
+                risky()
+            except Exception:
+                raise RuntimeError("context")
+            try:
+                risky()
+            except Exception:
+                return None
+        """,
+        rule="swallow-except",
+    )
+    assert vs == []
+
+
+def test_swallow_except_pragma_suppresses(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        def f():
+            try:
+                risky()
+            except Exception:  # analysis: ok(swallow-except)
+                pass
+        """,
+        rule="swallow-except",
+    )
+    assert vs == []
+
+
+def test_swallow_except_tests_and_benchmarks_exempt(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        def f():
+            try:
+                risky()
+            except:
+                pass
+        """,
+        rule="swallow-except",
+        filename="benchmarks/foo.py",
     )
     assert vs == []

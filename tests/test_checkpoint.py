@@ -1,11 +1,21 @@
 """Checkpoint/restore (mpi4dl_tpu/checkpoint.py): resume must be
-bit-identical, including flat pipeline buffers and optimizer state."""
+bit-identical, including flat pipeline buffers and optimizer state; files
+carry a CRC32 manifest + config fingerprint and restore_latest walks past
+invalid files (torn/corrupt/mismatched) to the newest valid one."""
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
-from mpi4dl_tpu.checkpoint import CheckpointManager, restore_state, save_state
+from mpi4dl_tpu.checkpoint import (
+    CheckpointInvalid,
+    CheckpointManager,
+    config_fingerprint,
+    load_arrays,
+    restore_state,
+    save_state,
+)
 from mpi4dl_tpu.mesh import MeshSpec, build_mesh
 from mpi4dl_tpu.models.resnet import get_resnet_v2
 from mpi4dl_tpu.parallel.partition import StagePartition
@@ -58,7 +68,8 @@ def test_pipeline_state_roundtrip(tmp_path, devices8):
     mgr.save(state, step_id=1)
 
     template = init_pipeline_state(part, params, opt, mesh)
-    restored = mgr.restore_latest(template)
+    restored, step_id = mgr.restore_latest(template)
+    assert step_id == 1
     np.testing.assert_array_equal(
         np.asarray(restored.param_buf), np.asarray(state.param_buf)
     )
@@ -83,7 +94,93 @@ def test_manager_keep_and_latest(tmp_path):
 def test_restore_rejects_mismatched_shapes(tmp_path):
     path = str(tmp_path / "ckpt_1.npz")
     save_state(path, {"w": jnp.ones((3,))}, 1)
-    import pytest
 
     with pytest.raises(ValueError):
         restore_state(path, {"w": jnp.ones((4,))})
+
+
+# ---------------------------------------------------------------------------
+# Manifest: CRC32, fingerprint, step-id round-trip (ISSUE 3)
+# ---------------------------------------------------------------------------
+
+
+def test_manifest_step_id_roundtrip(tmp_path):
+    path = str(tmp_path / "ckpt_7.npz")
+    save_state(path, {"w": jnp.arange(8.0)}, 7, fingerprint="abcd")
+    arrays, step_id = load_arrays(path, expected_fingerprint="abcd")
+    assert step_id == 7
+    np.testing.assert_array_equal(arrays["leaf_0"], np.arange(8.0))
+
+
+def test_manifest_detects_bit_corruption(tmp_path):
+    """Flipped bytes mid-file fail validation (zip CRC or manifest CRC32 —
+    either way CheckpointInvalid, never a silently-wrong resume)."""
+    from mpi4dl_tpu.resilience import corrupt_file
+
+    path = str(tmp_path / "ckpt_1.npz")
+    save_state(path, {"w": jnp.arange(64.0)}, 1)
+    corrupt_file(path)
+    with pytest.raises(CheckpointInvalid):
+        load_arrays(path)
+
+
+def test_fingerprint_mismatch_rejected(tmp_path):
+    path = str(tmp_path / "ckpt_1.npz")
+    save_state(path, {"w": jnp.ones((3,))}, 1, fingerprint="aaaa")
+    with pytest.raises(CheckpointInvalid):
+        load_arrays(path, expected_fingerprint="bbbb")
+    # no expected fingerprint -> accepted (old callers, ad-hoc restores)
+    _, step_id = load_arrays(path)
+    assert step_id == 1
+
+
+def test_restore_latest_mismatch_is_a_hard_error(tmp_path):
+    """All-files fingerprint mismatch (a DIFFERENT program, deterministic
+    user error) must raise even without require=True: a silent fresh start
+    would let the new run's saves prune the mismatched run's checkpoints."""
+    from mpi4dl_tpu.checkpoint import CheckpointMismatch
+
+    saver = CheckpointManager(str(tmp_path), fingerprint="aaaa")
+    saver.save({"w": jnp.ones((3,))}, step_id=5)
+    resumer = CheckpointManager(str(tmp_path), fingerprint="bbbb")
+    with pytest.raises(CheckpointMismatch):
+        resumer.restore_latest({"w": jnp.ones((3,))})
+    # wrong template structure (leaf shapes) is the same class of error
+    same_fp = CheckpointManager(str(tmp_path), fingerprint="aaaa")
+    with pytest.raises(CheckpointMismatch):
+        same_fp.restore_latest({"w": jnp.ones((4,))})
+
+
+def test_config_fingerprint_ignores_volatile_fields():
+    from mpi4dl_tpu.config import ParallelConfig
+
+    a = ParallelConfig(checkpoint_dir="/x", verbose=True, num_epochs=2)
+    # extending a run (more epochs) or moving it must still resume
+    b = ParallelConfig(checkpoint_dir="/y", verbose=False, num_epochs=4)
+    c = ParallelConfig(batch_size=64)
+    assert config_fingerprint(a) == config_fingerprint(b)
+    assert config_fingerprint(a) != config_fingerprint(c)
+    # set ordering is process/hash-seed dependent; the digest must not be
+    assert config_fingerprint({"s": {"b", "a", "c"}}) == config_fingerprint(
+        {"s": {"c", "a", "b"}}
+    )
+
+
+def test_restore_latest_require_raises_when_all_invalid(tmp_path):
+    from mpi4dl_tpu.resilience import corrupt_file
+
+    mgr = CheckpointManager(str(tmp_path))
+    corrupt_file(mgr.save({"w": jnp.ones((3,))}, step_id=1))
+    with pytest.raises(CheckpointInvalid):
+        mgr.restore_latest({"w": jnp.ones((3,))}, require=True)
+    # and on an empty directory too
+    empty = CheckpointManager(str(tmp_path / "empty"))
+    with pytest.raises(CheckpointInvalid):
+        empty.restore_latest({"w": jnp.ones((3,))}, require=True)
+
+
+def test_restore_latest_empty_dir_fresh_start(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    template = {"w": jnp.ones((3,))}
+    state, step_id = mgr.restore_latest(template)
+    assert step_id == 0 and state is template

@@ -4,7 +4,8 @@ Covers: RunLog JSONL schema round-trip; trace scopes visible in lowered
 StableHLO for all four engine families (lp / sp / gems / gems_sp on the
 virtual CPU mesh); cost_analysis FLOPs against a hand-computed conv count +
 the MFU arithmetic; the report CLI's golden output; the StepMeter extension;
-and the producer-thread shutdown fix in benchmarks/common._batches.
+and the producer-thread shutdown fix in the batch prefetcher (now
+mpi4dl_tpu.data.prefetch_batches).
 """
 
 from __future__ import annotations
@@ -373,7 +374,8 @@ def test_stepmeter_empty():
 
 
 # ---------------------------------------------------------------------------
-# benchmarks/common._batches producer shutdown (satellite 1)
+# data.prefetch_batches producer shutdown (PR-2 satellite 1; the iterator
+# moved from benchmarks/common._batches into the library for PR 3)
 # ---------------------------------------------------------------------------
 
 
@@ -392,31 +394,31 @@ def _wait_threads(n0: int, timeout: float = 5.0) -> bool:
 
 
 def test_batches_completes_normally():
-    from benchmarks.common import _batches
+    from mpi4dl_tpu.data import prefetch_batches
 
-    items = list(_batches(_StubDataset(), 4, steps=5, num_workers=2))
+    items = list(prefetch_batches(_StubDataset(), 4, 0, 5, num_workers=2))
     assert len(items) == 5
 
 
 def test_batches_early_exit_stops_producer():
     """Regression: a consumer abandoning the iterator mid-epoch must not
     leave the producer blocked forever on a full queue."""
-    from benchmarks.common import _batches
+    from mpi4dl_tpu.data import prefetch_batches
 
     n0 = threading.active_count()
-    gen = _batches(_StubDataset(), 4, steps=10_000, num_workers=2)
+    gen = prefetch_batches(_StubDataset(), 4, 0, 10_000, num_workers=2)
     next(gen)
     gen.close()  # the exception-mid-epoch path: generator finalized early
     assert _wait_threads(n0), "producer thread did not terminate"
 
 
 def test_batches_consumer_exception_stops_producer():
-    from benchmarks.common import _batches
+    from mpi4dl_tpu.data import prefetch_batches
 
     n0 = threading.active_count()
     with pytest.raises(RuntimeError):
         for i, _ in enumerate(
-            _batches(_StubDataset(), 4, steps=10_000, num_workers=1)
+            prefetch_batches(_StubDataset(), 4, 0, 10_000, num_workers=1)
         ):
             if i == 2:
                 raise RuntimeError("mid-epoch failure")

@@ -1,0 +1,522 @@
+"""Resilience subsystem (mpi4dl_tpu/resilience, ISSUE 3): recovery paths.
+
+The invariants that make the trainer crash-survivable, each driven by the
+deterministic fault injectors (``MPI4DL_FAULT`` semantics, here constructed
+directly):
+
+- corrupt-newest-checkpoint → restore falls back to the older valid file;
+- SIGTERM mid-run + resume → bit-identical final state vs. an
+  uninterrupted run (toy step, and the SP family on the virtual mesh);
+- NaN injection at step k → exactly ONE rollback, ``anomaly``/``recovery``
+  RunLog records, and the run still completes;
+- watchdog → stack dump on an artificially stalled step;
+- background writer → durable, equal to the sync path, errors latched;
+- data-producer retry → bounded backoff then fail-fast.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi4dl_tpu.checkpoint import CheckpointManager, load_arrays
+from mpi4dl_tpu.data import fetch_batch_with_retry, prefetch_batches
+from mpi4dl_tpu.obs import RunLog, read_runlog
+from mpi4dl_tpu.resilience import (
+    AnomalyError,
+    AnomalyGuard,
+    AsyncCheckpointWriter,
+    CheckpointWriteError,
+    FaultInjector,
+    FaultSpec,
+    StepWatchdog,
+    corrupt_file,
+    parse_fault,
+    run_supervised,
+)
+
+
+# ---------------------------------------------------------------------------
+# Toy harness: a deterministic 1-device step + index-addressed dataset, so
+# loop mechanics are tested without model builds or mesh compiles.
+# ---------------------------------------------------------------------------
+
+
+class _ToyDataset:
+    """Deterministic per-index regression batches (x @ [1,2,3,4] + noise)."""
+
+    def batch(self, idx, batch_size):
+        rng = np.random.default_rng(1000 + idx)
+        x = rng.standard_normal((batch_size, 4)).astype(np.float32)
+        y = (x @ np.array([1.0, 2.0, 3.0, 4.0], np.float32)).astype(np.float32)
+        return x, y
+
+
+def _toy_step():
+    @jax.jit
+    def step(state, x, y):
+        def loss_fn(w):
+            return jnp.mean((x @ w - y) ** 2)
+
+        loss, grad = jax.value_and_grad(loss_fn)(state["w"])
+        new_w = state["w"] - 0.05 * grad
+        return {"w": new_w}, {"loss": loss, "accuracy": jnp.float32(0.0)}
+
+    return step
+
+
+def _toy_state():
+    return {"w": jnp.zeros((4,), jnp.float32)}
+
+
+def _run_toy(tmp_path, *, steps=4, epochs=1, start=0, ckpt_dir=None,
+             faults=None, guard=None, runlog=None, watchdog_secs=0.0,
+             num_workers=0, state=None, snapshot_rollback=False):
+    ckpt = CheckpointManager(str(ckpt_dir)) if ckpt_dir is not None else None
+    if ckpt is not None and start == 0 and ckpt.latest_path() is not None:
+        st, start = ckpt.restore_latest(state or _toy_state())
+    else:
+        st = state or _toy_state()
+    return run_supervised(
+        _toy_step(), st, _ToyDataset(),
+        global_batch=8, steps_per_epoch=steps, num_epochs=epochs,
+        num_workers=num_workers, start_step=start, ckpt=ckpt,
+        runlog=runlog, guard=guard, faults=faults,
+        watchdog_secs=watchdog_secs, snapshot_rollback=snapshot_rollback,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Loop basics
+# ---------------------------------------------------------------------------
+
+
+def test_supervised_loop_completes(tmp_path):
+    res = _run_toy(tmp_path, steps=4)
+    assert res.steps_run == 4 and res.final_step == 4
+    assert not res.preempted and res.anomalies == 0
+    assert np.isfinite(res.metrics["loss"])
+
+
+def test_supervised_loop_epoch_checkpoints(tmp_path):
+    ckpt_dir = tmp_path / "ck"
+    _run_toy(tmp_path, steps=2, epochs=2, ckpt_dir=ckpt_dir,
+             guard=AnomalyGuard())
+    mgr = CheckpointManager(str(ckpt_dir))
+    # guard baseline at 0, epoch boundaries at 2 and 4 (keep=3)
+    assert mgr.latest_path().endswith("ckpt_4.npz")
+    _, step_id = mgr.restore_latest(_toy_state())
+    assert step_id == 4
+
+
+# ---------------------------------------------------------------------------
+# Corrupt-newest fallback
+# ---------------------------------------------------------------------------
+
+
+def test_corrupt_newest_checkpoint_falls_back(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep=3)
+    mgr.save({"w": jnp.full((4,), 1.0)}, step_id=1)
+    mgr.save({"w": jnp.full((4,), 2.0)}, step_id=2)
+    corrupt_file(mgr.latest_path())
+
+    state, step_id = mgr.restore_latest(_toy_state())
+    assert step_id == 1
+    np.testing.assert_array_equal(np.asarray(state["w"]), np.full((4,), 1.0))
+
+
+def test_torn_newest_checkpoint_falls_back(tmp_path):
+    """Truncation (the classic mid-write kill) is also walked past."""
+    mgr = CheckpointManager(str(tmp_path), keep=3)
+    mgr.save({"w": jnp.full((4,), 1.0)}, step_id=1)
+    path2 = mgr.save({"w": jnp.full((4,), 2.0)}, step_id=2)
+    import os
+
+    with open(path2, "r+b") as f:
+        f.truncate(os.path.getsize(path2) // 3)
+    state, step_id = mgr.restore_latest(_toy_state())
+    assert step_id == 1
+    np.testing.assert_array_equal(np.asarray(state["w"]), np.full((4,), 1.0))
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM kill-and-resume — bit-identical vs. uninterrupted
+# ---------------------------------------------------------------------------
+
+
+def test_kill_and_resume_bit_identical_toy(tmp_path):
+    control = _run_toy(tmp_path, steps=4)
+
+    ckpt_dir = tmp_path / "ck"
+    killed = _run_toy(
+        tmp_path, steps=4, ckpt_dir=ckpt_dir,
+        faults=FaultInjector(FaultSpec("sigterm", 2)),
+    )
+    assert killed.preempted and killed.final_step == 3
+    resumed = _run_toy(tmp_path, steps=4, ckpt_dir=ckpt_dir)
+    assert resumed.final_step == 4 and not resumed.preempted
+
+    assert float(resumed.metrics["loss"]) == float(control.metrics["loss"])
+    np.testing.assert_array_equal(
+        np.asarray(resumed.state["w"]), np.asarray(control.state["w"])
+    )
+
+
+def test_sp_kill_and_resume_bit_identical(tmp_path, devices8):
+    """The acceptance-criteria path: the SP family on the virtual mesh,
+    through the full benchmark entry point (flags → mesh → engine →
+    supervised loop → checkpoints → RunLog)."""
+    import os
+
+    from benchmarks.common import run
+
+    def argv(ck, tele):
+        return [
+            "--image-size", "32", "--num-layers", "1", "--batch-size", "4",
+            "--steps-per-epoch", "4",
+            "--checkpoint-dir", str(tmp_path / ck),
+            "--telemetry-dir", str(tmp_path / tele),
+        ]
+
+    control = run("sp", "resnet", argv("ck_a", "tele_a"))
+
+    os.environ["MPI4DL_FAULT"] = "sigterm@2"
+    try:
+        killed = run("sp", "resnet", argv("ck_b", "tele_b"))
+    finally:
+        del os.environ["MPI4DL_FAULT"]
+    assert killed["preempted"] and killed["final_step"] == 3
+
+    resumed = run("sp", "resnet", argv("ck_b", "tele_b"))
+    assert not resumed["preempted"] and resumed["final_step"] == 4
+    assert resumed["loss"] == control["loss"]  # bit-identical
+
+    # The resumed RunLog's final step record carries the control's loss too.
+    recs = []
+    for p in sorted((tmp_path / "tele_b").glob("*.jsonl")):
+        recs.extend(read_runlog(str(p)))
+    step_recs = sorted(
+        (r for r in recs if r["kind"] == "step"), key=lambda r: r["t"]
+    )
+    assert step_recs[-1]["loss"] == control["loss"]
+
+
+# ---------------------------------------------------------------------------
+# NaN injection → exactly one rollback, run completes
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kind", ["nan_loss", "nan_batch"])
+def test_nan_injection_one_rollback(tmp_path, kind):
+    runlog = RunLog(str(tmp_path / "run.jsonl"))
+    res = _run_toy(
+        tmp_path, steps=4, ckpt_dir=tmp_path / "ck",
+        faults=FaultInjector(FaultSpec(kind, 2)),
+        guard=AnomalyGuard(), runlog=runlog,
+    )
+    runlog.close()
+    assert res.anomalies == 1
+    assert res.final_step == 4  # completed despite the poison batch
+    assert np.isfinite(res.metrics["loss"])
+    assert np.all(np.isfinite(np.asarray(res.state["w"])))
+
+    recs = read_runlog(str(tmp_path / "run.jsonl"))
+    anomalies = [r for r in recs if r["kind"] == "anomaly"]
+    recoveries = [r for r in recs if r["kind"] == "recovery"]
+    assert len(anomalies) == 1 and anomalies[0]["gstep"] == 2
+    assert len(recoveries) == 1
+    assert recoveries[0]["skipped_step"] == 2
+    assert recoveries[0]["resumed_from"] == 0
+    # steps 0,1,3 ran; the poison batch was skipped, not retried
+    steps_logged = [r["gstep"] for r in recs if r["kind"] == "step"]
+    assert steps_logged == [0, 1, 3]
+
+
+def test_nan_rollback_with_snapshot_opt_in(tmp_path):
+    """No checkpoint dir + snapshot_rollback=True: the guard recovers from
+    the in-memory host snapshot and the run completes."""
+    res = _run_toy(
+        tmp_path, steps=4, snapshot_rollback=True,
+        faults=FaultInjector(FaultSpec("nan_loss", 1)),
+        guard=AnomalyGuard(),
+    )
+    assert res.anomalies == 1 and res.final_step == 4
+    assert np.isfinite(res.metrics["loss"])
+
+
+def test_nan_without_rollback_target_fails_fast(tmp_path):
+    """No checkpoint dir and no snapshot opt-in: detection-only — the run
+    dies loudly instead of silently training on poisoned state (or holding
+    an implicit full-state host copy)."""
+    with pytest.raises(AnomalyError):
+        _run_toy(
+            tmp_path, steps=4,
+            faults=FaultInjector(FaultSpec("nan_loss", 1)),
+            guard=AnomalyGuard(),
+        )
+
+
+def test_rollback_on_final_step_persists_progress(tmp_path):
+    """Poison batch at the very last step: the rolled-back state must still
+    be saved at step `total`, or every resume re-trains the whole run just
+    to re-skip the same batch."""
+    ckpt_dir = tmp_path / "ck"
+    res = _run_toy(
+        tmp_path, steps=4, ckpt_dir=ckpt_dir,
+        faults=FaultInjector(FaultSpec("nan_loss", 3)),
+        guard=AnomalyGuard(),
+    )
+    assert res.anomalies == 1 and res.final_step == 4
+    _, step_id = CheckpointManager(str(ckpt_dir)).restore_latest(_toy_state())
+    assert step_id == 4  # not the step-0 baseline
+
+
+def test_rollback_across_epoch_boundary_still_checkpoints(tmp_path):
+    """A poison batch at the LAST step of an epoch: the skip jumps past the
+    boundary, but the boundary checkpoint must still be written — otherwise
+    the rollback target ages by a whole extra epoch."""
+    ckpt_dir = tmp_path / "ck"
+    res = _run_toy(
+        tmp_path, steps=2, epochs=2, ckpt_dir=ckpt_dir,
+        faults=FaultInjector(FaultSpec("nan_loss", 1)),
+        guard=AnomalyGuard(),
+    )
+    assert res.anomalies == 1 and res.final_step == 4
+    import os
+
+    names = sorted(os.listdir(ckpt_dir))
+    assert names == ["ckpt_0.npz", "ckpt_2.npz", "ckpt_4.npz"]
+
+
+class _SigtermOnFetch:
+    """Dataset that delivers SIGTERM during the fetch of a given index —
+    the preemption-mid-fetch scenario."""
+
+    def __init__(self, at_idx):
+        self.at_idx = at_idx
+        self.inner = _ToyDataset()
+
+    def batch(self, idx, batch_size):
+        if idx == self.at_idx:
+            import os
+            import signal
+
+            os.kill(os.getpid(), signal.SIGTERM)
+        return self.inner.batch(idx, batch_size)
+
+
+def test_preemption_during_fetch_exits_without_extra_step(tmp_path):
+    """A signal landing during the batch fetch is honored BEFORE running
+    another step (the grace window may not cover one)."""
+    ckpt = CheckpointManager(str(tmp_path / "ck"))
+    res = run_supervised(
+        _toy_step(), _toy_state(), _SigtermOnFetch(2),
+        global_batch=8, steps_per_epoch=4, num_epochs=1, ckpt=ckpt,
+    )
+    assert res.preempted
+    assert res.steps_run == 2 and res.final_step == 2  # step 2 never ran
+    _, step_id = ckpt.restore_latest(_toy_state())
+    assert step_id == 2
+
+
+def test_rollback_with_all_checkpoints_invalid_fails_loudly(tmp_path):
+    """If every on-disk checkpoint is invalid at rollback time, the loop
+    must NOT hand the NaN-poisoned live state back as a 'recovery' — it
+    raises instead of silently training on corrupt weights."""
+    from mpi4dl_tpu.checkpoint import CheckpointInvalid
+
+    ckpt_dir = tmp_path / "ck"
+    mgr = CheckpointManager(str(ckpt_dir))
+    corrupt_file(mgr.save(_toy_state(), step_id=0))  # poisoned baseline
+    with pytest.raises(CheckpointInvalid):
+        _run_toy(
+            tmp_path, steps=4, ckpt_dir=ckpt_dir,
+            faults=FaultInjector(FaultSpec("nan_batch", 1)),
+            guard=AnomalyGuard(),
+        )
+
+
+def test_persistent_anomalies_fail_fast():
+    guard = AnomalyGuard(max_rollbacks=2)
+    guard.note_rollback()
+    guard.note_rollback()
+    with pytest.raises(AnomalyError):
+        guard.note_rollback()
+
+
+def test_guard_grad_norm_limit():
+    g = AnomalyGuard(grad_norm_limit=10.0)
+    assert g.check(1.0, {"grad_norm": 5.0}) is None
+    assert g.check(1.0, {"grad_norm": 50.0}) is not None
+    assert g.check(1.0, {}) is None  # opt-in: no metric, no check
+    assert g.check(float("inf")) is not None
+    assert AnomalyGuard().check(1.0, {"grad_norm": 1e30}) is None  # limit off
+
+
+# ---------------------------------------------------------------------------
+# Watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_fires_on_stalled_step(tmp_path, capfd):
+    """MPI4DL_FAULT=stall_data@2:0.6 + a 0.15 s budget: the producer stall
+    is covered (arm happens before the batch fetch) and the dump lands on
+    stderr while the run still completes."""
+    res = _run_toy(
+        tmp_path, steps=4, num_workers=1,
+        faults=FaultInjector(FaultSpec("stall_data", 2, 0.6)),
+        watchdog_secs=0.15,
+    )
+    assert res.final_step == 4
+    err = capfd.readouterr().err
+    assert "watchdog: step 2 exceeded" in err
+    assert "--- thread" in err  # the stack dump
+
+
+def test_watchdog_unit_fire_once_and_context():
+    out = io.StringIO()
+    wd = StepWatchdog(0.05, get_context=lambda: {"kind": "step", "gstep": 9},
+                      out=out)
+    with wd:
+        wd.arm("step 9")
+        time.sleep(0.4)
+        assert wd.fired == 1  # once per armed step, not per poll
+        wd.disarm()
+    text = out.getvalue()
+    assert "step 9 exceeded" in text
+    assert json.dumps({"kind": "step", "gstep": 9}) in text
+    assert "mpi4dl" in text or "MainThread" in text
+
+
+def test_watchdog_disarmed_never_fires():
+    out = io.StringIO()
+    with StepWatchdog(0.05, out=out) as wd:
+        wd.arm("fast step")
+        wd.disarm()
+        time.sleep(0.2)
+    assert wd.fired == 0 and out.getvalue() == ""
+
+
+# ---------------------------------------------------------------------------
+# Background checkpoint writer
+# ---------------------------------------------------------------------------
+
+
+def test_async_writer_matches_sync(tmp_path):
+    state = {"w": jnp.arange(16.0), "b": jnp.ones((2, 2))}
+    sync_mgr = CheckpointManager(str(tmp_path / "sync"), fingerprint="ff")
+    sync_path = sync_mgr.save(state, step_id=3)
+
+    async_mgr = CheckpointManager(str(tmp_path / "async"), fingerprint="ff")
+    with AsyncCheckpointWriter(async_mgr) as w:
+        apath = w.save(state, step_id=3)
+        w.flush()
+    a, sid_a = load_arrays(apath, expected_fingerprint="ff")
+    s, sid_s = load_arrays(sync_path, expected_fingerprint="ff")
+    assert sid_a == sid_s == 3
+    for k in s:
+        np.testing.assert_array_equal(a[k], s[k])
+
+
+def test_async_writer_latches_errors(tmp_path, monkeypatch):
+    mgr = CheckpointManager(str(tmp_path))
+    monkeypatch.setattr(
+        mgr, "save_arrays",
+        lambda arrays, step_id: (_ for _ in ()).throw(OSError("disk gone")),
+    )
+    w = AsyncCheckpointWriter(mgr)
+    w.save({"w": jnp.ones((2,))}, 1)
+    with pytest.raises(CheckpointWriteError):
+        w.flush()
+    w.close()
+
+
+# ---------------------------------------------------------------------------
+# Data-producer retry/backoff (satellite 2)
+# ---------------------------------------------------------------------------
+
+
+class _FlakyDataset:
+    def __init__(self, failures, exc=OSError):
+        self.failures = failures
+        self.exc = exc
+        self.calls = 0
+
+    def batch(self, idx, batch_size):
+        self.calls += 1
+        if self.calls <= self.failures:
+            raise self.exc(f"transient I/O #{self.calls}")
+        return (np.zeros((batch_size, 2), np.float32),
+                np.zeros((batch_size,), np.int32))
+
+
+def test_retry_recovers_from_transient_io():
+    ds = _FlakyDataset(failures=2)
+    sleeps = []
+    x, y = fetch_batch_with_retry(ds, 0, 4, retries=2, backoff=0.05,
+                                  _sleep=sleeps.append)
+    assert x.shape == (4, 2) and ds.calls == 3
+    assert sleeps == [0.05, 0.1]  # exponential backoff
+
+
+def test_retry_fails_fast_with_original_exception():
+    ds = _FlakyDataset(failures=99)
+    with pytest.raises(OSError, match="transient I/O #1"):
+        fetch_batch_with_retry(ds, 0, 4, retries=2, _sleep=lambda s: None)
+    assert ds.calls == 3  # bounded: 1 try + 2 retries
+
+
+def test_non_io_errors_propagate_immediately():
+    ds = _FlakyDataset(failures=99, exc=ValueError)
+    with pytest.raises(ValueError):
+        fetch_batch_with_retry(ds, 0, 4, retries=5, _sleep=lambda s: None)
+    assert ds.calls == 1
+
+
+def test_retry_through_producer_thread():
+    """The producer path (num_workers>0) retries too — the satellite's
+    replacement for the single-shot raise through the queue."""
+    ds = _FlakyDataset(failures=1)
+    items = list(prefetch_batches(ds, 4, 0, 3, num_workers=1, backoff=0.01))
+    assert [g for g, _ in items] == [0, 1, 2]
+
+
+def test_prefetch_batches_global_step_addressing():
+    seen = []
+
+    class _Rec:
+        def batch(self, idx, bs):
+            seen.append(idx)
+            return (np.zeros((bs, 1), np.float32),
+                    np.zeros((bs,), np.int32))
+
+    items = list(prefetch_batches(_Rec(), 2, 6, 10, index_of=lambda g: g % 4))
+    assert [g for g, _ in items] == [6, 7, 8, 9]
+    assert seen == [2, 3, 0, 1]  # epoch-relative dataset indices
+
+
+# ---------------------------------------------------------------------------
+# Fault-spec parsing
+# ---------------------------------------------------------------------------
+
+
+def test_parse_fault_forms():
+    assert parse_fault(None) is None and parse_fault("") is None
+    assert parse_fault("nan_loss@3") == FaultSpec("nan_loss", 3)
+    assert parse_fault("stall_data@2:1.5") == FaultSpec("stall_data", 2, 1.5)
+    for bad in ("nonsense@1", "sigterm", "sigterm@x", "@2"):
+        with pytest.raises(ValueError):
+            parse_fault(bad)
+
+
+def test_fault_injectors_fire_once():
+    inj = FaultInjector(FaultSpec("nan_loss", 2))
+    assert inj.poison_loss(1, 1.0) == 1.0
+    assert np.isnan(inj.poison_loss(2, 1.0))
+    assert inj.poison_loss(2, 1.0) == 1.0  # single-shot
