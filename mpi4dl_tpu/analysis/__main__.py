@@ -5,6 +5,11 @@ itself plus ``tests/``, ``benchmarks/``, ``bench.py`` and
 ``__graft_entry__.py`` (the env-hatch dead-flag check needs the whole tree —
 several hatches are read only by the harness).  Exit status: 0 when no
 violations remain after baseline filtering, 1 otherwise, 2 on usage errors.
+
+``python -m mpi4dl_tpu.analysis contracts ...`` dispatches to the
+compiled-artifact contract gate (analysis/contracts — lowers the engine
+families and diffs their StableHLO/jaxpr contracts against checked-in
+goldens; see its ``--help``).
 """
 
 from __future__ import annotations
@@ -12,8 +17,9 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
-from typing import List
+from typing import List, Optional
 
 from mpi4dl_tpu.analysis import (
     RULE_TABLE,
@@ -35,15 +41,75 @@ def repo_root() -> str:
     return os.path.dirname(pkg_dir)
 
 
+def scope_filter(paths: List[str], scope: List[str]) -> List[str]:
+    """Restrict absolute paths to those inside the gate's scan scope (a
+    scope entry is a file to match exactly or a directory prefix)."""
+    out = []
+    for p in paths:
+        for s in scope:
+            if p == s or p.startswith(s.rstrip(os.sep) + os.sep):
+                out.append(p)
+                break
+    return out
+
+
+def changed_python_files(root: str) -> Optional[List[str]]:
+    """Repo-relative ``.py`` paths touched per git (worktree + index +
+    untracked), for ``--changed-only`` pre-commit runs.  None when git is
+    unavailable (caller falls back to a full scan)."""
+    names: List[str] = []
+    # git emits names relative to the TOPLEVEL, which may sit above `root`
+    # (repo vendored inside an outer git repo) — resolve against it, not
+    # root, or every changed file fails the exists check and the gate
+    # silently passes.
+    for cmd in (
+        ["git", "-C", root, "rev-parse", "--show-toplevel"],
+        ["git", "-C", root, "diff", "--name-only", "HEAD"],
+        ["git", "-C", root, "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=30, check=True
+            )
+        except (OSError, subprocess.SubprocessError):
+            return None
+        if cmd[3] == "rev-parse":
+            toplevel = proc.stdout.strip() or root
+        else:
+            names.extend(proc.stdout.splitlines())
+    out = []
+    for name in dict.fromkeys(names):  # dedup, keep order
+        path = os.path.join(toplevel, name)
+        if name.endswith(".py") and os.path.exists(path):
+            out.append(path)
+    return out
+
+
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "contracts":
+        from mpi4dl_tpu.analysis.contracts.__main__ import main as contracts_main
+
+        return contracts_main(argv[1:])
+
     ap = argparse.ArgumentParser(
         prog="python -m mpi4dl_tpu.analysis",
-        description="Shard-safety static analyzer (see docs/analysis.md).",
+        description="Shard-safety static analyzer (see docs/analysis.md). "
+        "The `contracts` subcommand runs the compiled-artifact contract "
+        "gate instead.",
     )
     ap.add_argument("paths", nargs="*", help="files/dirs to scan (default: repo tree)")
     ap.add_argument("--json", action="store_true", help="machine-readable output")
     ap.add_argument("--baseline", metavar="F", default=None,
                     help="JSON list of accepted violations to filter out")
+    ap.add_argument("--prune-baseline", action="store_true",
+                    help="rewrite --baseline dropping stale entries "
+                         "(entries that no longer match any violation)")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="scan only files git reports as changed/untracked "
+                         "(fast pre-commit mode; the dead-flag direction of "
+                         "env-hatch and stale-baseline reporting are "
+                         "disabled — both need a whole-tree scan)")
     ap.add_argument("--rule", action="append", default=None, metavar="NAME",
                     help="run only the named rule(s)")
     ap.add_argument("--list-rules", action="store_true")
@@ -60,9 +126,53 @@ def main(argv=None) -> int:
 
         print(hatches_markdown())
         return 0
+    if args.prune_baseline and not args.baseline:
+        print("analysis: --prune-baseline requires --baseline",
+              file=sys.stderr)
+        return 2
+    if args.prune_baseline and args.changed_only:
+        # staleness is judged against the FULL violation set; a partial scan
+        # would mark every entry for an unscanned file stale and prune it
+        print("analysis: --prune-baseline needs a whole-tree scan and "
+              "cannot be combined with --changed-only", file=sys.stderr)
+        return 2
+
+    # `contracts` dispatches only as the FIRST token; a flag-first spelling
+    # (`--json contracts`) would otherwise be treated as a scan path with
+    # no .py files in it and exit 0 looking like a passed gate.
+    if "contracts" in args.paths:
+        print(
+            "analysis: `contracts` must come first: "
+            "python -m mpi4dl_tpu.analysis contracts [flags]",
+            file=sys.stderr,
+        )
+        return 2
 
     root = repo_root()
-    paths = args.paths or default_paths(root)
+    partial_scan = False  # True only when actually scanning a subset
+    if args.changed_only:
+        if args.paths:
+            print("analysis: --changed-only and explicit paths are "
+                  "mutually exclusive", file=sys.stderr)
+            return 2
+        changed = changed_python_files(root)
+        if changed is None:
+            print("analysis: git unavailable; --changed-only falling back "
+                  "to a full scan", file=sys.stderr)
+            paths = default_paths(root)
+        else:
+            # same scope as the full gate — a changed file OUTSIDE the
+            # default tree must not fail here when the real gate and CI
+            # would never scan it
+            changed = scope_filter(changed, default_paths(root))
+            if not changed:
+                print("analysis: no changed python files in scope",
+                      file=sys.stderr)
+                return 0
+            paths = changed
+            partial_scan = True
+    else:
+        paths = args.paths or default_paths(root)
     if not paths:
         print("analysis: nothing to scan", file=sys.stderr)
         return 2
@@ -78,12 +188,30 @@ def main(argv=None) -> int:
         rules = [by_name[n] for n in args.rule]
 
     project = build_project(paths, root=root)
+    if partial_scan:
+        # The dead-flag direction needs every hatch reader in scope; a
+        # partial scan that happens to include config.py would flag hatches
+        # whose reads live in unscanned files.
+        project.hatch_decl_in_scan = False
     violations = run_rules(project, rules)
 
     stale: List[dict] = []
     if args.baseline:
         baseline = load_baseline(args.baseline)
         violations, stale = apply_baseline(violations, baseline)
+        if partial_scan:
+            stale = []  # staleness is meaningless on a partial scan
+        if stale and args.prune_baseline:
+            kept = [e for e in baseline if e not in stale]
+            with open(args.baseline, "w", encoding="utf-8") as fh:
+                json.dump(kept, fh, indent=1)
+                fh.write("\n")
+            print(
+                f"analysis: pruned {len(stale)} stale baseline entr"
+                f"{'y' if len(stale) == 1 else 'ies'} from {args.baseline} "
+                f"({len(kept)} kept)",
+                file=sys.stderr,
+            )
 
     if args.json:
         print(json.dumps(
@@ -105,9 +233,19 @@ def main(argv=None) -> int:
         for v in violations:
             print(v.render())
         for e in stale:
+            msg = (
+                f"stale baseline entry (no longer fires): "
+                f"{e.get('path')}: [{e.get('rule')}] {e.get('message')}"
+            )
+            print(f"warning: {msg}", file=sys.stderr)
+            if os.environ.get("GITHUB_ACTIONS"):
+                # Surfaced as an inline annotation on the CI run.
+                print(f"::warning title=stale analyzer baseline::{msg}")
+        if stale and not args.prune_baseline:
             print(
-                f"note: stale baseline entry (no longer fires): "
-                f"{e.get('path')}: [{e.get('rule')}] {e.get('message')}",
+                f"warning: {len(stale)} stale baseline entr"
+                f"{'y' if len(stale) == 1 else 'ies'} — rewrite with "
+                "--prune-baseline",
                 file=sys.stderr,
             )
         n_files = len(project.files)

@@ -1,0 +1,45 @@
+"""Compiled-artifact contract gate (ISSUE 4 tentpole).
+
+The shard-safety analyzer (rules 1-9) lints *source*; this package extends
+static analysis to the *compiled artifact*: each engine family (lp / sp /
+gems / gems_sp) is lowered — never executed — on the virtual mesh, and a
+structured **contract** is extracted from the lowered StableHLO + jaxpr:
+
+- collective op counts and bytes, keyed by the ``obs.scope`` name they
+  appear under (an accidental extra all-gather in a halo exchange names the
+  offending scope, not just a total);
+- collective counts and bytes per mesh axis (from the jaxpr's collective
+  equations — the semantic view before XLA fuses/reassociates);
+- the scope-coverage set (instrumentation that silently disappears drifts);
+- trace/lowering counts during build+lower (the retrace budget);
+- GSPMD sharding annotations and entry shapes (a resharding inserted at a
+  junction shows here before any benchmark regresses).
+
+Contracts are checked into ``contracts/<engine>.json`` as goldens;
+``python -m mpi4dl_tpu.analysis contracts`` re-extracts and diffs, exiting
+nonzero on drift with a human-readable report (``--update`` rewrites the
+goldens, ``--json`` emits the machine-readable diff).  See docs/analysis.md.
+"""
+
+from __future__ import annotations
+
+from mpi4dl_tpu.analysis.contracts.diff import (
+    diff_contracts,
+    render_drift_report,
+)
+from mpi4dl_tpu.analysis.contracts.engines import ENGINE_FAMILIES, build_engine
+from mpi4dl_tpu.analysis.contracts.extract import (
+    CONTRACT_SCHEMA,
+    extract_contract,
+    jaxpr_collective_stats,
+)
+
+__all__ = [
+    "CONTRACT_SCHEMA",
+    "ENGINE_FAMILIES",
+    "build_engine",
+    "diff_contracts",
+    "extract_contract",
+    "jaxpr_collective_stats",
+    "render_drift_report",
+]

@@ -1,0 +1,127 @@
+"""CLI: ``python -m mpi4dl_tpu.analysis contracts [--update] [--json]``
+(also reachable as ``python -m mpi4dl_tpu.analysis.contracts``).
+
+Checks the freshly-extracted per-engine contracts against the goldens in
+``contracts/*.json`` at the repo root.  Exit status mirrors the analyzer:
+0 = no drift, 1 = drift (or missing golden), 2 = usage/environment errors.
+``--update`` rewrites the goldens instead of failing; ``--json`` prints the
+machine-readable diff (the CI job uploads it as an artifact on failure);
+``--out F`` additionally writes that JSON to a file in either mode.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List
+
+
+def default_contracts_dir() -> str:
+    from mpi4dl_tpu.analysis.__main__ import repo_root
+
+    return os.path.join(repo_root(), "contracts")
+
+
+def golden_path(directory: str, family: str) -> str:
+    return os.path.join(directory, f"{family}.json")
+
+
+def main(argv=None) -> int:
+    from mpi4dl_tpu.analysis.contracts.diff import (
+        diff_contracts,
+        render_drift_report,
+    )
+    from mpi4dl_tpu.analysis.contracts.engines import ENGINE_FAMILIES
+    from mpi4dl_tpu.analysis.contracts.extract import (
+        ensure_virtual_mesh,
+        extract_contract,
+    )
+
+    ap = argparse.ArgumentParser(
+        prog="python -m mpi4dl_tpu.analysis contracts",
+        description="Compiled-artifact contract gate (docs/analysis.md): "
+        "lower each engine family on the virtual mesh and diff its "
+        "StableHLO/jaxpr contract against the checked-in golden.",
+    )
+    ap.add_argument("--update", action="store_true",
+                    help="rewrite the goldens from the current artifacts")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable diff on stdout")
+    ap.add_argument("--out", metavar="F", default=None,
+                    help="also write the JSON diff to this file")
+    ap.add_argument("--dir", metavar="D", default=None,
+                    help="goldens directory (default: <repo>/contracts)")
+    ap.add_argument("--engines", metavar="NAMES", default=None,
+                    help="comma-separated subset of engine families "
+                         f"(default: {','.join(ENGINE_FAMILIES)})")
+    args = ap.parse_args(argv)
+
+    families = list(ENGINE_FAMILIES)
+    if args.engines:
+        families = [f.strip() for f in args.engines.split(",") if f.strip()]
+        unknown = [f for f in families if f not in ENGINE_FAMILIES]
+        if unknown:
+            print(f"contracts: unknown engine(s) {unknown}; "
+                  f"have {list(ENGINE_FAMILIES)}", file=sys.stderr)
+            return 2
+
+    err = ensure_virtual_mesh(families)
+    if err:
+        print(f"contracts: {err}", file=sys.stderr)
+        return 2
+
+    directory = args.dir or default_contracts_dir()
+    report: Dict[str, List[dict]] = {}
+    rc = 0
+    for family in families:
+        current = extract_contract(family)
+        path = golden_path(directory, family)
+        if args.update:
+            os.makedirs(directory, exist_ok=True)
+            with open(path, "w", encoding="utf-8") as fh:
+                json.dump(current, fh, indent=1, sort_keys=True)
+                fh.write("\n")
+            if not args.json:
+                print(f"contract written: {path}")
+            report[family] = []
+            continue
+        if not os.path.exists(path):
+            report[family] = [{"kind": "meta", "field": "golden",
+                               "golden": None, "current": path}]
+            if not args.json:
+                print(f"contract MISSING: no golden at {path} "
+                      "(run with --update to create it)")
+            rc = 1
+            continue
+        with open(path, "r", encoding="utf-8") as fh:
+            golden = json.load(fh)
+        drifts = diff_contracts(golden, current)
+        report[family] = drifts
+        if drifts:
+            rc = 1
+        if not args.json:
+            print(render_drift_report(family, drifts))
+            if drifts and golden.get("jax") != current.get("jax"):
+                print(
+                    f"  note: golden was extracted on jax "
+                    f"{golden.get('jax')}, this run is jax "
+                    f"{current.get('jax')} — lowering differences may be "
+                    "version skew, not a code change"
+                )
+
+    payload = json.dumps({"drift": report}, indent=2, sort_keys=True)
+    if args.json:
+        print(payload)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(payload + "\n")
+    if rc == 0 and not args.json and not args.update:
+        print(f"contracts: {len(families)} engine famil"
+              f"{'y' if len(families) == 1 else 'ies'} clean")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,168 @@
+"""Contract diffing: golden vs current, with a drift report a human can
+act on — which scope gained/lost which collective, byte deltas, coverage
+and sharding changes.
+
+Drift records are dicts with a ``kind`` discriminator so the JSON output is
+machine-checkable (the CI job uploads it as an artifact on failure):
+
+- ``collective``: per-(scope, op) count/byte delta (count_golden/_current,
+  bytes_golden/_current);
+- ``axis-collective``: per-(mesh axis, primitive) delta from the jaxpr view;
+- ``scope-coverage``: a scope name appeared in / disappeared from the
+  lowered artifact;
+- ``lowerings``: trace/lowering count moved (retrace budget);
+- ``sharding``: a GSPMD sharding annotation histogram entry or an entry
+  shape changed;
+- ``meta``: schema/engine mismatch (golden unusable — regenerate).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+
+def _counted(d: dict, *path) -> Dict[str, int]:
+    for key in path:
+        d = d.get(key, {}) if isinstance(d, dict) else {}
+    if not isinstance(d, dict):
+        return {}
+    return d
+
+
+def _diff_counted_tree(
+    kind: str, golden: dict, current: dict, label: str
+) -> List[dict]:
+    """Diff two {outer: {op: {count, bytes}}} trees into drift records."""
+    out: List[dict] = []
+    for outer in sorted(set(golden) | set(current)):
+        g_ops, c_ops = golden.get(outer, {}), current.get(outer, {})
+        for op in sorted(set(g_ops) | set(c_ops)):
+            g = g_ops.get(op, {"count": 0, "bytes": 0})
+            c = c_ops.get(op, {"count": 0, "bytes": 0})
+            if g == c:
+                continue
+            out.append({
+                "kind": kind,
+                label: outer,
+                "op": op,
+                "count_golden": g.get("count", 0),
+                "count_current": c.get("count", 0),
+                "bytes_golden": g.get("bytes", 0),
+                "bytes_current": c.get("bytes", 0),
+            })
+    return out
+
+
+def diff_contracts(golden: dict, current: dict) -> List[dict]:
+    """All drift records between a golden and a freshly-extracted contract.
+    Empty list = the artifact still honors the contract."""
+    drifts: List[dict] = []
+    for field in ("schema", "engine"):
+        if golden.get(field) != current.get(field):
+            drifts.append({
+                "kind": "meta", "field": field,
+                "golden": golden.get(field), "current": current.get(field),
+            })
+    if drifts:
+        return drifts  # mismatched contracts — field diffs are meaningless
+
+    drifts += _diff_counted_tree(
+        "collective", _counted(golden, "collectives"),
+        _counted(current, "collectives"), "scope",
+    )
+    drifts += _diff_counted_tree(
+        "axis-collective", _counted(golden, "axis_collectives"),
+        _counted(current, "axis_collectives"), "axis",
+    )
+
+    g_scopes = set(golden.get("scopes", ()))
+    c_scopes = set(current.get("scopes", ()))
+    for name in sorted(g_scopes - c_scopes):
+        drifts.append({"kind": "scope-coverage", "scope": name,
+                       "change": "lost"})
+    for name in sorted(c_scopes - g_scopes):
+        drifts.append({"kind": "scope-coverage", "scope": name,
+                       "change": "gained"})
+
+    g_low = golden.get("lowerings", {})
+    c_low = current.get("lowerings", {})
+    for field in sorted(set(g_low) | set(c_low)):
+        if g_low.get(field) != c_low.get(field):
+            drifts.append({
+                "kind": "lowerings", "field": field,
+                "golden": g_low.get(field), "current": c_low.get(field),
+            })
+
+    g_sh = _counted(golden, "shardings", "annotations")
+    c_sh = _counted(current, "shardings", "annotations")
+    for name in sorted(set(g_sh) | set(c_sh)):
+        if g_sh.get(name, 0) != c_sh.get(name, 0):
+            drifts.append({
+                "kind": "sharding", "annotation": name,
+                "count_golden": g_sh.get(name, 0),
+                "count_current": c_sh.get(name, 0),
+            })
+    g_in = golden.get("shardings", {}).get("inputs", [])
+    c_in = current.get("shardings", {}).get("inputs", [])
+    if g_in != c_in:
+        drifts.append({
+            "kind": "sharding", "annotation": "<entry shapes>",
+            "golden": g_in, "current": c_in,
+        })
+    return drifts
+
+
+def _fmt_delta(golden: int, current: int) -> str:
+    delta = current - golden
+    return f"{golden} -> {current} ({'+' if delta >= 0 else ''}{delta})"
+
+
+def render_drift_report(engine: str, drifts: List[dict]) -> str:
+    """Human-readable drift report for one engine."""
+    if not drifts:
+        return f"contract ok: engine {engine}"
+    lines = [f"contract DRIFT: engine {engine} ({len(drifts)} finding(s))"]
+    for d in drifts:
+        kind = d["kind"]
+        if kind == "meta":
+            lines.append(
+                f"  {d['field']} mismatch: golden {d['golden']!r} vs "
+                f"current {d['current']!r} — regenerate with --update"
+            )
+        elif kind in ("collective", "axis-collective"):
+            where = ("scope " + d["scope"]) if kind == "collective" else (
+                "mesh axis " + d["axis"])
+            g_n, c_n = d["count_golden"], d["count_current"]
+            if g_n == 0:
+                verb = f"{d['op']} APPEARED (count {c_n}, " \
+                       f"{d['bytes_current']} bytes)"
+            elif c_n == 0:
+                verb = f"{d['op']} DISAPPEARED (was count {g_n}, " \
+                       f"{d['bytes_golden']} bytes)"
+            else:
+                verb = (
+                    f"{d['op']} count {_fmt_delta(g_n, c_n)}, bytes "
+                    f"{_fmt_delta(d['bytes_golden'], d['bytes_current'])}"
+                )
+            lines.append(f"  {where}: {verb}")
+        elif kind == "scope-coverage":
+            lines.append(f"  scope coverage {d['change']}: {d['scope']}")
+        elif kind == "lowerings":
+            lines.append(
+                f"  lowerings.{d['field']}: "
+                f"{_fmt_delta(d['golden'], d['current'])} (retrace budget)"
+            )
+        elif kind == "sharding":
+            if "count_golden" in d:
+                lines.append(
+                    f"  sharding annotation {d['annotation']}: count "
+                    f"{_fmt_delta(d['count_golden'], d['count_current'])}"
+                )
+            else:
+                lines.append(
+                    f"  sharding {d['annotation']}: golden {d['golden']} "
+                    f"vs current {d['current']}"
+                )
+        else:
+            lines.append(f"  {d}")
+    return "\n".join(lines)

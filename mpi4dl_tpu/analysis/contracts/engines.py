@@ -1,0 +1,109 @@
+"""Canonical per-family engine builds for contract extraction.
+
+One fixed tiny configuration per engine family, mirroring the virtual-mesh
+builds the obs scope tests lower (tests/test_obs.py) and the family dispatch
+in benchmarks/common.build_train: ResNet-11 at 32px on the 8-device CPU
+mesh, 2 pipeline stages, a 2-wide spatial tile grid where the family is
+spatial.  Small enough to lower in seconds on any host; rich enough that
+every structural collective of the family (halo ppermutes, junction
+gather/reduce-scatter, stage handoffs, GEMS mirror, BN psums, gradient
+all-reduces) appears in the artifact.
+
+The contract is a *structural* invariant, so the exact numbers here are
+arbitrary but FROZEN: changing a constant in this module is a contract
+change and requires ``--update`` plus review of the golden diff.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+ENGINE_FAMILIES: Tuple[str, ...] = ("lp", "sp", "gems", "gems_sp")
+
+# Frozen build constants (see module docstring before touching these).
+_DEPTH = 11
+_PX = 32
+_BATCH = 4
+_GEMS_SP_BATCH = 8
+_CLASSES = 10
+_STAGES = 2
+_PARTS = 2  # microbatches
+_SPW = 2
+_SEED = 0
+
+
+def required_devices(family: str) -> int:
+    """Virtual-mesh device count the family's canonical build needs."""
+    return _STAGES * _SPW if family in ("sp", "gems_sp") else _STAGES
+
+
+def build_engine(family: str):
+    """Build the family's canonical train step on the virtual mesh.
+
+    Returns ``(step, args)`` where ``step`` is the jitted train step and
+    ``args`` the abstract-ready argument tuple — ``step.lower(*args)`` is
+    the only thing callers do with it (contracts never execute).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from mpi4dl_tpu.layer_ctx import SpatialCtx
+    from mpi4dl_tpu.mesh import AXIS_SPW, MeshSpec, build_mesh
+    from mpi4dl_tpu.models.resnet import get_resnet_v2
+    from mpi4dl_tpu.train import Optimizer
+
+    if family not in ENGINE_FAMILIES:
+        raise ValueError(f"unknown engine family {family!r}; "
+                         f"have {ENGINE_FAMILIES}")
+
+    batch = _GEMS_SP_BATCH if family == "gems_sp" else _BATCH
+    model = get_resnet_v2((batch, _PX, _PX, 3), depth=_DEPTH,
+                          num_classes=_CLASSES)
+    params, _ = model.init(jax.random.key(_SEED))
+    opt = Optimizer("sgd", lr=0.01)
+    x = jnp.zeros((batch, _PX, _PX, 3), jnp.float32)
+    y = jnp.zeros((batch,), jnp.int32)
+
+    if family in ("lp", "gems"):
+        from mpi4dl_tpu.parallel.partition import StagePartition
+        from mpi4dl_tpu.parallel.pipeline import init_pipeline_state
+
+        mesh = build_mesh(MeshSpec(stage=_STAGES), jax.devices()[:_STAGES])
+        micro = batch // (_PARTS if family == "lp" else 2 * _PARTS)
+        part = StagePartition.build(
+            model, params, _STAGES, (micro, _PX, _PX, 3)
+        )
+        if family == "lp":
+            from mpi4dl_tpu.parallel.pipeline import make_pipeline_train_step
+
+            step = make_pipeline_train_step(part, opt, mesh, parts=_PARTS)
+        else:
+            from mpi4dl_tpu.parallel.gems import make_gems_train_step
+
+            step = make_gems_train_step(part, opt, mesh, parts=_PARTS,
+                                        times=1)
+        state = init_pipeline_state(part, params, opt, mesh)
+        return step, (state, x, y)
+
+    # Spatial families: SP x PP (sp) and GEMS x SP x PP (gems_sp).
+    from mpi4dl_tpu.parallel.sp_pipeline import (
+        SPPipeline,
+        init_sp_pipeline_state,
+        make_sp_gems_train_step,
+        make_sp_pipeline_train_step,
+    )
+
+    model.spatial_until = 2
+    sp = SpatialCtx(axis_w=AXIS_SPW, grid_w=_SPW)
+    mesh = build_mesh(
+        MeshSpec(stage=_STAGES, spw=_SPW), jax.devices()[:_STAGES * _SPW]
+    )
+    micro = batch // (_PARTS if family == "sp" else 2 * _PARTS)
+    spp = SPPipeline.build(model, params, _STAGES, sp, micro,
+                           junction="gather")
+    if family == "sp":
+        step = make_sp_pipeline_train_step(spp, opt, mesh, parts=_PARTS)
+    else:
+        step = make_sp_gems_train_step(spp, opt, mesh, parts=_PARTS, times=1)
+    state = init_sp_pipeline_state(spp, params, opt, mesh)
+    return step, (state, x, y)

@@ -9,7 +9,9 @@ hours away).
 
 Vocabulary:
 
-- A :class:`SourceFile` is one parsed module: its AST, per-line pragma
+- A :class:`SourceFile` is one parsed module: its AST (walked once into a
+  shared by-node-type index that every rule iterates via
+  :meth:`SourceFile.nodes` — no per-rule re-walks), per-line pragma
   allowlist, and an import-alias table (so rules can resolve ``np``/``jnp``/
   ``P`` to their canonical modules without executing anything).
 - A :class:`Project` is the set of scanned files plus the extracted ground
@@ -60,16 +62,31 @@ class Violation:
 
 
 class SourceFile:
-    """One parsed python module with pragma and import-alias tables."""
+    """One parsed python module with pragma and import-alias tables.
+
+    The AST is walked exactly once at construction into a by-node-type
+    index; rules iterate :meth:`nodes` instead of re-walking the whole tree
+    per rule (the dominant cost of a whole-repo scan before this index)."""
 
     def __init__(self, path: str, rel: str, text: str):
         self.path = path
         self.rel = rel.replace(os.sep, "/")
         self.text = text
         self.tree = ast.parse(text, filename=path)
+        self.by_type: Dict[type, List[ast.AST]] = {}
+        for node in ast.walk(self.tree):
+            self.by_type.setdefault(type(node), []).append(node)
         self.pragmas = self._collect_pragmas(text)
-        self.aliases = self._collect_aliases(self.tree)
-        self.func_spans = self._collect_func_spans(self.tree)
+        self.aliases = self._collect_aliases(self)
+        self.func_spans = self._collect_func_spans(self)
+
+    def nodes(self, *types: type) -> Iterable[ast.AST]:
+        """Every node of the given AST type(s), from the shared one-pass
+        index.  Order is ``ast.walk`` order (breadth-first): nested nodes
+        come after shallower ones regardless of line number — rules that
+        need lexical structure must check spans, not index order."""
+        for t in types:
+            yield from self.by_type.get(t, ())
 
     # -- pragmas -----------------------------------------------------------
     @staticmethod
@@ -89,13 +106,12 @@ class SourceFile:
         return out
 
     @staticmethod
-    def _collect_func_spans(tree: ast.AST) -> List[Tuple[int, int, int]]:
+    def _collect_func_spans(src: "SourceFile") -> List[Tuple[int, int, int]]:
         """(def_line, body_start, body_end) for every function."""
         spans = []
-        for node in ast.walk(tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                end = getattr(node, "end_lineno", node.lineno)
-                spans.append((node.lineno, node.lineno, end))
+        for node in src.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+            end = getattr(node, "end_lineno", node.lineno)
+            spans.append((node.lineno, node.lineno, end))
         return spans
 
     def suppressed(self, rule: str, line: int) -> bool:
@@ -114,7 +130,7 @@ class SourceFile:
 
     # -- import aliases ----------------------------------------------------
     @staticmethod
-    def _collect_aliases(tree: ast.AST) -> Dict[str, str]:
+    def _collect_aliases(src: "SourceFile") -> Dict[str, str]:
         """Map local name -> dotted canonical origin.
 
         ``import numpy as np`` -> {'np': 'numpy'};
@@ -124,13 +140,19 @@ class SourceFile:
         Collected from every scope (local imports are common here).
         """
         out: Dict[str, str] = {}
-        for node in ast.walk(tree):
+        # Document order so a later rebinding of the same alias wins,
+        # matching runtime semantics (the two node types interleave).
+        nodes = sorted(
+            src.nodes(ast.Import, ast.ImportFrom),
+            key=lambda n: (n.lineno, n.col_offset),
+        )
+        for node in nodes:
             if isinstance(node, ast.Import):
                 for a in node.names:
                     out[a.asname or a.name.split(".")[0]] = (
                         a.name if a.asname else a.name.split(".")[0]
                     )
-            elif isinstance(node, ast.ImportFrom) and node.module:
+            elif node.module:
                 for a in node.names:
                     out[a.asname or a.name] = f"{node.module}.{a.name}"
         return out
@@ -263,26 +285,25 @@ def environ_reads(src: SourceFile) -> Iterable[Tuple[str, int]]:
     """(name, line) for every env *read* of a string-literal key:
     ``os.environ.get/pop/setdefault(K)``, ``os.environ[K]`` (Load ctx), and
     ``getenv(K)``."""
-    for node in ast.walk(src.tree):
-        if isinstance(node, ast.Call):
-            key = None
-            f = node.func
-            if (
-                isinstance(f, ast.Attribute)
-                and f.attr in ("get", "pop", "setdefault")
-                and isinstance(f.value, ast.Attribute)
-                and f.value.attr == "environ"
-            ):
-                key = node.args[0] if node.args else None
-            elif isinstance(f, ast.Attribute) and f.attr == "getenv":
-                key = node.args[0] if node.args else None
-            elif isinstance(f, ast.Name) and f.id == "getenv":
-                key = node.args[0] if node.args else None
-            if isinstance(key, ast.Constant) and isinstance(key.value, str):
-                yield key.value, node.lineno
-        elif (
-            isinstance(node, ast.Subscript)
-            and isinstance(node.ctx, ast.Load)
+    for node in src.nodes(ast.Call):
+        key = None
+        f = node.func
+        if (
+            isinstance(f, ast.Attribute)
+            and f.attr in ("get", "pop", "setdefault")
+            and isinstance(f.value, ast.Attribute)
+            and f.value.attr == "environ"
+        ):
+            key = node.args[0] if node.args else None
+        elif isinstance(f, ast.Attribute) and f.attr == "getenv":
+            key = node.args[0] if node.args else None
+        elif isinstance(f, ast.Name) and f.id == "getenv":
+            key = node.args[0] if node.args else None
+        if isinstance(key, ast.Constant) and isinstance(key.value, str):
+            yield key.value, node.lineno
+    for node in src.nodes(ast.Subscript):
+        if (
+            isinstance(node.ctx, ast.Load)
             and isinstance(node.value, ast.Attribute)
             and node.value.attr == "environ"
             and isinstance(node.slice, ast.Constant)

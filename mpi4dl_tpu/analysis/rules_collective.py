@@ -82,9 +82,7 @@ class CollectiveAxisRule(Rule):
 
     def _check_file(self, src: SourceFile, project: Project) -> List[Violation]:
         out: List[Violation] = []
-        for node in ast.walk(src.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in src.nodes(ast.Call):
             resolved = src.resolve(node.func) or ""
             tail = resolved.rsplit(".", 1)[-1]
             # --- collectives (lax.psum(...), jax.lax.ppermute(...)) -------

@@ -75,7 +75,7 @@ class DtypePolicyRule(Rule):
 
     def _check_float64(self, src: SourceFile) -> List[Violation]:
         out = []
-        for node in ast.walk(src.tree):
+        for node in src.nodes(ast.Attribute, ast.Name, ast.Constant):
             resolved = None
             if isinstance(node, (ast.Attribute, ast.Name)):
                 resolved = src.resolve(node)
@@ -95,9 +95,7 @@ class DtypePolicyRule(Rule):
 
     def _check_constructors(self, src: SourceFile) -> List[Violation]:
         out = []
-        for node in ast.walk(src.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in src.nodes(ast.Call):
             resolved = src.resolve(node.func) or ""
             if resolved not in _CONSTRUCTORS:
                 continue
@@ -120,8 +118,8 @@ class DtypePolicyRule(Rule):
 
     def _check_param_init(self, src: SourceFile) -> List[Violation]:
         out = []
-        for fnode in ast.walk(src.tree):
-            if not isinstance(fnode, ast.FunctionDef) or fnode.name != "init":
+        for fnode in src.nodes(ast.FunctionDef):
+            if fnode.name != "init":
                 continue
             for node in ast.walk(fnode):
                 if not isinstance(node, ast.Call):

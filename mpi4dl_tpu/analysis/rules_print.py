@@ -35,10 +35,9 @@ class PrintCallRule(Rule):
         for src in project.package_files():
             if src.rel.endswith("__main__.py"):
                 continue
-            for node in ast.walk(src.tree):
+            for node in src.nodes(ast.Call):
                 if (
-                    isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Name)
+                    isinstance(node.func, ast.Name)
                     and node.func.id == "print"
                     # a locally-bound `print` (alias/param) is not builtin
                     and src.aliases.get("print", "print") == "print"

@@ -77,13 +77,9 @@ class RetraceRule(Rule):
     def _check_static_args(self, src: SourceFile) -> List[Violation]:
         out = []
         funcs: Dict[str, ast.FunctionDef] = {
-            n.name: n
-            for n in ast.walk(src.tree)
-            if isinstance(n, ast.FunctionDef)
+            n.name: n for n in src.nodes(ast.FunctionDef)
         }
-        for node in ast.walk(src.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in src.nodes(ast.Call):
             resolved = src.resolve(node.func) or ""
             if resolved.split(".")[-1] != "jit":
                 continue

@@ -61,9 +61,7 @@ class SwallowExceptRule(Rule):
     def check(self, project: Project) -> List[Violation]:
         out: List[Violation] = []
         for src in project.package_files():
-            for node in ast.walk(src.tree):
-                if not isinstance(node, ast.ExceptHandler):
-                    continue
+            for node in src.nodes(ast.ExceptHandler):
                 if node.type is None:
                     out.append(
                         Violation(

@@ -19,6 +19,9 @@ decorated with ``jit``, ``shard_map``, ``checkpoint``/``remat``,
 defs of a reachable function are reachable (they run under the same trace
 when called).  Attribute calls (``self.f()``) and cross-module calls are
 not followed — see docs/analysis.md.
+
+(This rule needs lexical scope structure, so it runs its own
+``NodeVisitor`` instead of the flat shared index in ``SourceFile.nodes``.)
 """
 
 from __future__ import annotations

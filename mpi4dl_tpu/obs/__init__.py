@@ -40,16 +40,21 @@ from mpi4dl_tpu.obs.costs import (
     step_cost,
 )
 from mpi4dl_tpu.obs.hlo_stats import (
+    clean_scope_path,
     compiled_collective_stats,
     hlo_collective_stats,
+    scope_coverage,
     scope_names,
+    stablehlo_collectives,
     stablehlo_debug_text,
+    stablehlo_sharding_annotations,
 )
 
 __all__ = [
     "RunLog",
     "active_hatches",
     "arithmetic_intensity",
+    "clean_scope_path",
     "compiled_collective_stats",
     "compiled_cost",
     "device_memory_watermark",
@@ -60,9 +65,12 @@ __all__ = [
     "peak_flops",
     "read_runlog",
     "scope",
+    "scope_coverage",
     "scope_names",
     "scopes_enabled",
+    "stablehlo_collectives",
     "stablehlo_debug_text",
+    "stablehlo_sharding_annotations",
     "step_annotation",
     "step_cost",
 ]

@@ -19,11 +19,19 @@ without paying for a compile.
 from __future__ import annotations
 
 import re
-from typing import Dict
+from typing import Dict, List, Optional
 
 COLLECTIVE_CLASSES = (
     "collective-permute", "all-reduce", "all-gather", "reduce-scatter",
     "all-to-all",
+)
+
+# StableHLO op names of the same five classes (the *lowered*, pre-compile
+# artifact — what the contract gate in analysis/contracts reads).
+STABLEHLO_COLLECTIVES = (
+    "stablehlo.collective_permute", "stablehlo.all_reduce",
+    "stablehlo.all_gather", "stablehlo.reduce_scatter",
+    "stablehlo.all_to_all",
 )
 
 _DTYPE_BYTES = {
@@ -120,3 +128,156 @@ def scope_names(debug_text: str) -> Dict[str, int]:
                 continue
             out[comp] = out.get(comp, 0) + 1
     return out
+
+
+# ---------------------------------------------------------------------------
+# Lowered-StableHLO structural extraction (the compiled-artifact contract
+# gate's raw material: analysis/contracts reads collectives, scope coverage
+# and sharding annotations from a jax.stages.Lowered WITHOUT compiling).
+# ---------------------------------------------------------------------------
+
+# Transform wrappers jax threads into the op-name path; unwrapped so the
+# forward op and its AD transpose land under the SAME semantic scope.
+_WRAPPER_RE = re.compile(
+    r"^(?:jvp|vjp|transpose|vmap|pmap|custom_jvp|custom_vjp|checkpoint|"
+    r"remat|rematted_computation)\((.*)\)$"
+)
+
+# Bare framing components jax control-flow/remat lowering inserts into the
+# path; dropped so scope keys stay the ``obs.scope`` vocabulary (a remat
+# policy change moves collectives BETWEEN these frames without changing the
+# semantic region they belong to).
+_FRAMING_COMPONENTS = re.compile(
+    r"^(?:checkpoint|rematted_computation|remat|while|body|cond|"
+    r"branch_\d+(?:_fun)?|None)$"
+)
+
+_MLIR_TENSOR_RE = re.compile(r"tensor<(?:([0-9x]+)x)?([a-z][a-z0-9]+)>")
+
+_MLIR_DTYPE_BYTES = {
+    "f32": 4, "bf16": 2, "f16": 2, "f64": 8, "i1": 1, "i8": 1, "ui8": 1,
+    "i16": 2, "ui16": 2, "i32": 4, "ui32": 4, "i64": 8, "ui64": 8,
+}
+
+
+def clean_scope_component(comp: str) -> Optional[str]:
+    """One op-name path component reduced to its semantic scope name:
+    ``jvp(sp_level0)`` -> ``sp_level0``; jit/shmap framing -> None."""
+    while True:
+        m = _WRAPPER_RE.match(comp)
+        if m is None:
+            break
+        comp = m.group(1)
+    if not comp or comp.startswith(("jit(", "shmap", "pjit(")):
+        return None
+    if _FRAMING_COMPONENTS.match(comp):
+        return None
+    return comp
+
+
+def clean_scope_path(op_name_path: str) -> str:
+    """Scope key for one op-name path: wrapper/framing components cleaned,
+    the trailing primitive name dropped (it is the op, not a scope) —
+    ``jit(step)/jit(main)/jit(shmap_body)/jvp(sp_level0)/cell00/
+    halo_exchange_spw/ppermute`` -> ``sp_level0/cell00/halo_exchange_spw``."""
+    comps = [clean_scope_component(c) for c in op_name_path.split("/")[:-1]]
+    return "/".join(c for c in comps if c)
+
+
+def _mlir_type_bytes(type_str: str) -> int:
+    """Total payload bytes of an MLIR type string; tuples sum members."""
+    total = 0
+    for dims, dt in _MLIR_TENSOR_RE.findall(type_str):
+        n = 1
+        for d in dims.split("x"):
+            if d:
+                n *= int(d)
+        total += n * _MLIR_DTYPE_BYTES.get(dt, 4)
+    return total
+
+
+def _named_loc_path(loc_str: str) -> Optional[str]:
+    """The op-name path inside an MLIR location string, if any:
+    ``loc("jit(step)/.../ppermute"(callsite(...)))`` -> the quoted path."""
+    m = re.search(r'"((?:jit|shmap|pjit)[^"]*)"', loc_str)
+    return m.group(1) if m else None
+
+
+def _walk_mlir_ops(op):
+    yield op
+    for region in op.regions:
+        for block in region:
+            for inner in block:
+                yield from _walk_mlir_ops(inner)
+
+
+def stablehlo_collectives(lowered) -> List[dict]:
+    """Every collective op in a Lowered's StableHLO module, as
+    ``{"kind", "scope", "bytes"}`` dicts — kind is the bare StableHLO op name
+    (``all_reduce``...), scope the :func:`clean_scope_path` of its location,
+    bytes the op's total result payload.  Walks the MLIR module directly (no
+    text round-trip, no compile)."""
+    mod = lowered.compiler_ir("stablehlo")
+    out: List[dict] = []
+    for func in mod.body:
+        for op in _walk_mlir_ops(func):
+            name = op.operation.name if hasattr(op, "operation") else op.name
+            if name not in STABLEHLO_COLLECTIVES:
+                continue
+            path = _named_loc_path(str(op.location))
+            nbytes = sum(_mlir_type_bytes(str(r.type)) for r in op.results)
+            out.append({
+                "kind": name.split(".", 1)[1],
+                "scope": clean_scope_path(path) if path else "",
+                "bytes": nbytes,
+            })
+    return out
+
+
+def stablehlo_sharding_annotations(lowered) -> Dict[str, int]:
+    """Histogram of GSPMD sharding annotations (``mhlo.sharding`` on
+    ``Sharding``/``SPMDFullToShardShape``/``SPMDShardToFullShape`` custom
+    calls) in a Lowered's StableHLO — the pre-partitioning record of every
+    sharding constraint and shard_map boundary.  A junction that starts
+    resharding differently shows up here before any benchmark regresses."""
+    mod = lowered.compiler_ir("stablehlo")
+    out: Dict[str, int] = {}
+    for func in mod.body:
+        for op in _walk_mlir_ops(func):
+            name = op.operation.name if hasattr(op, "operation") else op.name
+            if name != "stablehlo.custom_call":
+                continue
+            attrs = op.attributes
+            try:
+                target = str(attrs["call_target_name"]).strip('"')
+            except KeyError:
+                continue
+            if target not in (
+                "Sharding", "SPMDFullToShardShape", "SPMDShardToFullShape",
+            ):
+                continue
+            try:
+                sharding = str(attrs["mhlo.sharding"]).strip('"')
+            except KeyError:
+                sharding = "<unannotated>"
+            key = f"{target}:{sharding}"
+            out[key] = out.get(key, 0) + 1
+    return out
+
+
+def scope_coverage(lowered) -> List[str]:
+    """Sorted set of semantic scope names reachable in a Lowered's StableHLO
+    locations — the contract gate's drift check for *instrumentation* (an
+    ``obs.scope`` that stops covering its region disappears from here)."""
+    mod = lowered.compiler_ir("stablehlo")
+    names = set()
+    for func in mod.body:
+        for op in _walk_mlir_ops(func):
+            path = _named_loc_path(str(op.location))
+            if not path:
+                continue
+            for comp in path.split("/")[:-1]:
+                cleaned = clean_scope_component(comp)
+                if cleaned:
+                    names.add(cleaned)
+    return sorted(names)

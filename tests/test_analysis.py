@@ -469,7 +469,8 @@ def test_cli_exit_codes(tmp_path):
     )
     assert r.returncode == 0
     for name in ("collective-axis", "tracer-leak", "dtype-policy",
-                 "env-hatch", "retrace", "print-call", "swallow-except"):
+                 "env-hatch", "retrace", "print-call", "swallow-except",
+                 "thread-shared-state"):
         assert name in r.stdout
 
 
@@ -641,3 +642,366 @@ def test_swallow_except_tests_and_benchmarks_exempt(tmp_path):
         filename="benchmarks/foo.py",
     )
     assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# (9) thread-shared-state
+# ---------------------------------------------------------------------------
+
+
+def test_thread_state_method_target_positive(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        import threading
+
+        class Collector:
+            def __init__(self):
+                self.results = []
+                self.done = False
+                self._t = threading.Thread(target=self._work)
+
+            def _work(self):
+                self.results.append(1)
+                self.done = True
+        """,
+        rule="thread-shared-state",
+    )
+    msgs = "\n".join(v.message for v in vs)
+    assert len(vs) == 2
+    assert "self.results" in msgs and "self.done" in msgs
+
+
+def test_thread_state_lock_present_negative(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        import threading
+
+        class Collector:
+            def __init__(self):
+                self.results = []
+                self._lock = threading.Lock()
+                self._t = threading.Thread(target=self._work)
+
+            def _work(self):
+                with self._lock:
+                    self.results.append(1)
+        """,
+        rule="thread-shared-state",
+    )
+    assert vs == []
+
+
+def test_thread_state_subclass_run_global_positive(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        import threading
+
+        COUNTER = 0
+
+        class Worker(threading.Thread):
+            def run(self):
+                global COUNTER
+                COUNTER += 1
+        """,
+        rule="thread-shared-state",
+    )
+    assert len(vs) == 1 and "COUNTER" in vs[0].message
+
+
+def test_thread_state_module_container_positive(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        import threading
+
+        RESULTS = []
+
+        def work():
+            RESULTS.append(1)
+
+        t = threading.Thread(target=work)
+        """,
+        rule="thread-shared-state",
+    )
+    assert len(vs) == 1 and "RESULTS" in vs[0].message
+
+
+def test_thread_state_queue_in_closure_scope_negative(tmp_path):
+    # the prefetch-producer pattern (mpi4dl_tpu.data.prefetch_batches):
+    # a closure target whose enclosing function owns a Queue/Event
+    vs = _run(
+        tmp_path,
+        """
+        import queue
+        import threading
+
+        def fetch_all(items):
+            q = queue.Queue()
+
+            def producer():
+                for i in items:
+                    q.put(i)
+
+            t = threading.Thread(target=producer)
+            t.start()
+            return q
+        """,
+        rule="thread-shared-state",
+    )
+    assert vs == []
+
+
+def test_thread_state_pragma_suppresses(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self.x = 0
+                self._t = threading.Thread(target=self._work)
+
+            def _work(self):  # analysis: ok(thread-shared-state)
+                self.x = 1
+        """,
+        rule="thread-shared-state",
+    )
+    assert vs == []
+
+
+def test_thread_state_tests_exempt(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self.x = 0
+                threading.Thread(target=self._work).start()
+
+            def _work(self):
+                self.x = 1
+        """,
+        rule="thread-shared-state",
+        filename="tests/foo.py",
+    )
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# Stale-baseline hygiene (--prune-baseline) + --changed-only
+# ---------------------------------------------------------------------------
+
+
+def _write_violating_file(tmp_path):
+    f = tmp_path / "bad.py"
+    f.write_text(
+        'from jax import lax\n\ndef f(x):\n    return lax.psum(x, "nope")\n'
+    )
+    return f
+
+
+def test_stale_baseline_reported_and_pruned(tmp_path, capsys):
+    from mpi4dl_tpu.analysis.__main__ import main
+
+    f = _write_violating_file(tmp_path)
+    live = {
+        "rule": "collective-axis",
+        "path": os.path.relpath(str(f), repo_root()).replace(os.sep, "/"),
+        "message": "psum: axis 'nope' is not a mesh axis "
+                   "('data', 'stage', 'sph', 'spw')",
+    }
+    stale = {"rule": "collective-axis", "path": "gone/file.py",
+             "message": "psum: axis 'old' is not a mesh axis ..."}
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps([live, stale]))
+
+    # without --prune-baseline: warning surfaced, file untouched
+    rc = main([str(f), "--baseline", str(bl)])
+    err = capsys.readouterr().err
+    assert rc == 0  # the live violation is baselined away
+    assert "warning: stale baseline entry" in err
+    assert "--prune-baseline" in err
+    assert json.loads(bl.read_text()) == [live, stale]
+
+    # with --prune-baseline: file rewritten keeping only the live entry
+    rc = main([str(f), "--baseline", str(bl), "--prune-baseline"])
+    err = capsys.readouterr().err
+    assert rc == 0
+    assert "pruned 1 stale baseline entry" in err
+    assert json.loads(bl.read_text()) == [live]
+
+
+def test_prune_baseline_requires_baseline(capsys):
+    from mpi4dl_tpu.analysis.__main__ import main
+
+    assert main(["--prune-baseline"]) == 2
+    assert "--prune-baseline requires --baseline" in capsys.readouterr().err
+
+
+def test_changed_only_rejects_explicit_paths(tmp_path, capsys):
+    from mpi4dl_tpu.analysis.__main__ import main
+
+    assert main(["--changed-only", str(tmp_path)]) == 2
+    assert "mutually exclusive" in capsys.readouterr().err
+
+
+def test_changed_only_rejects_prune_baseline(tmp_path, capsys):
+    # a partial scan would judge nearly every baseline entry stale and
+    # destructively prune it
+    from mpi4dl_tpu.analysis.__main__ import main
+
+    bl = tmp_path / "baseline.json"
+    bl.write_text("[]")
+    assert main(["--changed-only", "--baseline", str(bl),
+                 "--prune-baseline"]) == 2
+    assert "whole-tree scan" in capsys.readouterr().err
+
+
+def test_thread_state_target_defined_after_call_in_function(tmp_path):
+    """A module-level target defined BELOW the function that spawns the
+    thread is fully legal Python and must still be analyzed."""
+    vs = _run(
+        tmp_path,
+        """
+        import threading
+
+        def start():
+            t = threading.Thread(target=work)
+            t.start()
+
+        RESULTS = []
+
+        def work():
+            RESULTS.append(1)
+        """,
+        rule="thread-shared-state",
+    )
+    assert len(vs) == 1 and "RESULTS" in vs[0].message
+
+
+def test_thread_state_two_spawn_sites_report_once(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        import threading
+
+        RESULTS = []
+
+        def work():
+            RESULTS.append(1)
+
+        t1 = threading.Thread(target=work)
+        t2 = threading.Thread(target=work)
+        """,
+        rule="thread-shared-state",
+    )
+    assert len(vs) == 1
+
+
+def test_changed_only_scope_filter():
+    from mpi4dl_tpu.analysis.__main__ import scope_filter
+
+    scope = ["/r/mpi4dl_tpu", "/r/tests", "/r/bench.py"]
+    assert scope_filter(
+        ["/r/mpi4dl_tpu/ops/x.py", "/r/native/helper.py", "/r/bench.py",
+         "/r/bench.py.bak", "/r/tests/test_x.py"],
+        scope,
+    ) == ["/r/mpi4dl_tpu/ops/x.py", "/r/bench.py", "/r/tests/test_x.py"]
+
+
+def test_thread_state_bare_annotation_not_a_mutation(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                threading.Thread(target=self._work).start()
+
+            def _work(self):
+                self.buf: list  # declaration only, no store
+        """,
+        rule="thread-shared-state",
+    )
+    assert vs == []
+
+
+def test_thread_state_method_does_not_shadow_module_target(tmp_path):
+    """A same-named METHOD elsewhere in the file must not shadow the real
+    module-level Thread target (methods are not name-visible)."""
+    vs = _run(
+        tmp_path,
+        """
+        import threading
+
+        class Manager:
+            def work(self):
+                self.jobs = []
+
+        JOBS = []
+
+        def work():
+            JOBS.append(1)
+
+        t = threading.Thread(target=work)
+        """,
+        rule="thread-shared-state",
+    )
+    # the module-level target's JOBS mutation fires; the method's self.jobs
+    # (not a thread body) does not
+    assert len(vs) == 1 and "JOBS" in vs[0].message
+
+
+def test_changed_python_files_sees_worktree_and_untracked(tmp_path):
+    from mpi4dl_tpu.analysis.__main__ import changed_python_files
+
+    env = dict(os.environ, GIT_AUTHOR_NAME="t", GIT_AUTHOR_EMAIL="t@t",
+               GIT_COMMITTER_NAME="t", GIT_COMMITTER_EMAIL="t@t")
+
+    def git(*args):
+        subprocess.run(["git", "-C", str(tmp_path), *args], check=True,
+                       capture_output=True, env=env)
+
+    git("init", "-q")
+    (tmp_path / "clean.py").write_text("A = 1\n")
+    (tmp_path / "tracked.py").write_text("B = 1\n")
+    git("add", "clean.py", "tracked.py")
+    git("commit", "-qm", "seed")
+    (tmp_path / "tracked.py").write_text("B = 2\n")  # worktree change
+    (tmp_path / "new.py").write_text("C = 3\n")  # untracked
+    (tmp_path / "notes.txt").write_text("not python\n")
+
+    changed = changed_python_files(str(tmp_path))
+    names = sorted(os.path.basename(p) for p in changed)
+    assert names == ["new.py", "tracked.py"]
+
+
+def test_changed_python_files_no_git(tmp_path):
+    from mpi4dl_tpu.analysis.__main__ import changed_python_files
+
+    # a directory that is not a git repo -> None (caller falls back)
+    assert changed_python_files(str(tmp_path)) is None
+
+
+def test_shared_node_index_matches_full_walk(tmp_path):
+    """SourceFile.nodes (the one-pass shared index every rule iterates)
+    must see exactly the nodes a fresh ast.walk sees."""
+    import ast
+
+    from mpi4dl_tpu.analysis.core import SourceFile
+
+    text = (tmp_path / "m.py")
+    text.write_text(
+        "import os\n\nclass C:\n    def f(self):\n        return "
+        "os.environ.get('X')\n\nY = [c for c in 'ab']\n"
+    )
+    src = SourceFile(str(text), "m.py", text.read_text())
+    walked = [n for n in ast.walk(src.tree) if isinstance(n, ast.Call)]
+    assert list(src.nodes(ast.Call)) == walked

@@ -1,0 +1,271 @@
+"""Tests for the compiled-artifact contract gate (ISSUE 4 tentpole).
+
+Covers: golden round-trip for all four engine families on the virtual mesh
+(the checked-in ``contracts/*.json`` must match a fresh extraction exactly —
+including the warm-pass retrace budget, which is deliberately
+history-independent); a negative test injecting an extra collective through
+a test-only halo perturbation and asserting the gate names the offending
+scope; the diff/report machinery on synthetic contracts; the scope-path
+cleaner; and the CLI's missing-golden / --update / clean flows.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mpi4dl_tpu.analysis.contracts import (
+    ENGINE_FAMILIES,
+    diff_contracts,
+    extract_contract,
+    render_drift_report,
+)
+from mpi4dl_tpu.obs.hlo_stats import clean_scope_path
+
+
+def _golden_dir() -> str:
+    from mpi4dl_tpu.analysis.contracts.__main__ import default_contracts_dir
+
+    return default_contracts_dir()
+
+
+def _load_golden(family: str) -> dict:
+    path = os.path.join(_golden_dir(), f"{family}.json")
+    assert os.path.exists(path), (
+        f"no checked-in golden for {family}; run "
+        "`python -m mpi4dl_tpu.analysis contracts --update`"
+    )
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def _require_golden_jax(golden: dict) -> None:
+    """Contracts are lowering artifacts: under a different jax than the
+    golden records, differences are version skew, not code drift (the CI
+    contract-drift job pins jax to the golden's version for this reason) —
+    skip rather than fail."""
+    import jax
+
+    if golden.get("jax") != jax.__version__:
+        pytest.skip(
+            f"golden extracted under jax {golden.get('jax')}, running "
+            f"{jax.__version__} — covered by the version-pinned "
+            "contract-drift CI job"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Golden round-trip: all four engine families
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("family", ENGINE_FAMILIES)
+def test_golden_contract_roundtrip(family, devices8):
+    golden = _load_golden(family)
+    _require_golden_jax(golden)
+    current = extract_contract(family)
+    drifts = diff_contracts(golden, current)
+    assert drifts == [], render_drift_report(family, drifts)
+
+
+# ---------------------------------------------------------------------------
+# Negative: an injected extra collective is detected and localized
+# ---------------------------------------------------------------------------
+
+
+def test_injected_collective_names_offending_scope(devices8, monkeypatch):
+    """A test-only perturbation of the halo exchange (each neighbour pull
+    does a second ppermute hop) must drift the contract at exactly the
+    ``halo_exchange_spw`` scopes, with the collective named."""
+    import mpi4dl_tpu.ops.halo as halo
+
+    golden = _load_golden("sp")
+    _require_golden_jax(golden)
+    orig = halo._shift_from_prev
+    monkeypatch.setattr(
+        halo, "_shift_from_prev",
+        lambda x, axis_name, n, step=1: orig(
+            orig(x, axis_name, n, step), axis_name, n, step
+        ),
+    )
+    current = extract_contract("sp")
+    drifts = diff_contracts(golden, current)
+    assert drifts, "perturbed artifact produced no drift"
+
+    coll = [d for d in drifts if d["kind"] == "collective"]
+    assert coll, f"no per-scope collective drift in {drifts}"
+    for d in coll:
+        # every collective drift is localized to a halo-exchange scope, and
+        # is an INCREASE in collective_permute
+        assert "halo_exchange_spw" in d["scope"], d
+        assert d["op"] == "collective_permute", d
+        assert d["count_current"] > d["count_golden"], d
+        assert d["bytes_current"] > d["bytes_golden"], d
+    # the jaxpr per-axis view corroborates: more ppermutes on the spw axis
+    axis = [d for d in drifts if d["kind"] == "axis-collective"]
+    assert any(d["axis"] == "spw" and d["op"] == "ppermute" for d in axis)
+    # no unrelated drift kinds (scope coverage, shardings, retrace budget
+    # must be untouched by this perturbation)
+    assert {d["kind"] for d in drifts} == {"collective", "axis-collective"}
+
+    report = render_drift_report("sp", drifts)
+    assert "halo_exchange_spw" in report
+    assert "collective_permute" in report
+
+
+# ---------------------------------------------------------------------------
+# Diff + report machinery (synthetic, no lowering)
+# ---------------------------------------------------------------------------
+
+
+def _synthetic(**overrides) -> dict:
+    base = {
+        "schema": 1,
+        "engine": "sp",
+        "jax": "0.0.0",
+        "collectives": {
+            "cell00/halo_exchange_spw": {
+                "collective_permute": {"count": 4, "bytes": 1024},
+            },
+            "junction_gather": {"all_gather": {"count": 1, "bytes": 4096}},
+        },
+        "axis_collectives": {
+            "spw": {"ppermute": {"count": 4, "bytes": 1024}},
+        },
+        "scopes": ["cell00", "halo_exchange_spw", "junction_gather"],
+        "lowerings": {"traces": 5, "modules": 1},
+        "shardings": {
+            "annotations": {"Sharding:{replicated}": 2},
+            "inputs": ["float32[4, 32, 32, 3]"],
+        },
+    }
+    base.update(overrides)
+    return base
+
+
+def test_diff_identical_contracts_clean():
+    assert diff_contracts(_synthetic(), _synthetic()) == []
+    assert "contract ok" in render_drift_report("sp", [])
+
+
+def test_diff_appeared_and_disappeared_collectives():
+    current = _synthetic(collectives={
+        "cell00/halo_exchange_spw": {
+            "collective_permute": {"count": 6, "bytes": 2048},
+        },
+        "junction_gather": {"reduce_scatter": {"count": 1, "bytes": 512}},
+    })
+    drifts = diff_contracts(_synthetic(), current)
+    kinds = {(d["kind"], d.get("scope"), d.get("op")) for d in drifts
+             if d["kind"] == "collective"}
+    assert ("collective", "cell00/halo_exchange_spw",
+            "collective_permute") in kinds
+    assert ("collective", "junction_gather", "all_gather") in kinds
+    assert ("collective", "junction_gather", "reduce_scatter") in kinds
+    report = render_drift_report("sp", drifts)
+    assert "count 4 -> 6 (+2)" in report
+    assert "all_gather DISAPPEARED" in report
+    assert "reduce_scatter APPEARED" in report
+
+
+def test_diff_scope_coverage_and_lowerings():
+    current = _synthetic(
+        scopes=["cell00", "junction_gather", "new_scope"],
+        lowerings={"traces": 9, "modules": 1},
+    )
+    drifts = diff_contracts(_synthetic(), current)
+    assert {"kind": "scope-coverage", "scope": "halo_exchange_spw",
+            "change": "lost"} in drifts
+    assert {"kind": "scope-coverage", "scope": "new_scope",
+            "change": "gained"} in drifts
+    report = render_drift_report("sp", drifts)
+    assert "scope coverage lost: halo_exchange_spw" in report
+    assert "lowerings.traces: 5 -> 9 (+4) (retrace budget)" in report
+
+
+def test_diff_sharding_annotations():
+    current = _synthetic(shardings={
+        "annotations": {"Sharding:{replicated}": 2,
+                        "Sharding:{devices=[1,2]<=[2]}": 1},
+        "inputs": ["float32[4, 32, 32, 3]"],
+    })
+    drifts = diff_contracts(_synthetic(), current)
+    assert any(d["kind"] == "sharding" and "devices=[1,2]" in d["annotation"]
+               for d in drifts)
+
+
+def test_diff_meta_mismatch_short_circuits():
+    drifts = diff_contracts(_synthetic(), _synthetic(engine="lp"))
+    assert drifts == [{"kind": "meta", "field": "engine",
+                       "golden": "sp", "current": "lp"}]
+    assert "regenerate with --update" in render_drift_report("sp", drifts)
+
+
+# ---------------------------------------------------------------------------
+# Scope-path cleaning
+# ---------------------------------------------------------------------------
+
+
+def test_clean_scope_path():
+    assert clean_scope_path(
+        "jit(step)/jit(main)/jit(shmap_body)/jvp(sp_level0)/cell00/"
+        "halo_exchange_spw/ppermute"
+    ) == "sp_level0/cell00/halo_exchange_spw"
+    # AD transpose lands under the same scope as the forward op
+    assert clean_scope_path(
+        "jit(step)/jit(main)/jit(shmap_body)/transpose(jvp(junction_gather))"
+        "/reduce_scatter"
+    ) == "junction_gather"
+    # remat/control-flow framing components are dropped
+    assert clean_scope_path(
+        "jit(step)/sp_region/checkpoint/rematted_computation/sp_level0/"
+        "cell00/checkpoint/halo_exchange_spw/ppermute"
+    ) == "sp_region/sp_level0/cell00/halo_exchange_spw"
+    assert clean_scope_path(
+        "jit(step)/tail_scan/while/body/stage_handoff/ppermute"
+    ) == "tail_scan/stage_handoff"
+    # fully-framed paths clean to empty
+    assert clean_scope_path("jit(step)/jit(main)/add") == ""
+
+
+# ---------------------------------------------------------------------------
+# CLI flows (in-process: missing golden -> --update -> clean)
+# ---------------------------------------------------------------------------
+
+
+def test_contracts_cli_update_then_clean(tmp_path, devices8, capsys):
+    from mpi4dl_tpu.analysis.contracts.__main__ import main
+
+    d = str(tmp_path / "contracts")
+    assert main(["--engines", "sp", "--dir", d]) == 1
+    assert "MISSING" in capsys.readouterr().out
+
+    assert main(["--engines", "sp", "--dir", d, "--update"]) == 0
+    assert os.path.exists(os.path.join(d, "sp.json"))
+    capsys.readouterr()
+
+    assert main(["--engines", "sp", "--dir", d, "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["drift"] == {"sp": []}
+
+
+def test_contracts_cli_unknown_engine(capsys):
+    from mpi4dl_tpu.analysis.contracts.__main__ import main
+
+    assert main(["--engines", "bogus"]) == 2
+    # usage errors go to stderr so --json stdout stays parseable
+    assert "unknown engine" in capsys.readouterr().err
+
+
+def test_analysis_cli_rejects_misplaced_contracts_token(capsys):
+    """`--json contracts` must not silently run the source analyzer over a
+    goldens directory with no .py files and exit 0."""
+    from mpi4dl_tpu.analysis.__main__ import main
+
+    assert main(["--json", "contracts"]) == 2
+    assert "must come first" in capsys.readouterr().err
