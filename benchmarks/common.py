@@ -122,6 +122,13 @@ def build_train(cfg: ParallelConfig, family: str, mesh):
         params = jax.tree.map(lambda p: p.astype(pdtype), params)
     from_probs = cfg.softmax_in_model
 
+    if cfg.schedule != "gpipe" and cfg.split_size <= 1:
+        print(
+            f"note: --schedule {cfg.schedule} needs a pipeline "
+            "(--split-size >= 2); single-chip path ignores it",
+            file=sys.stderr,
+        )
+
     if family == "lp":
         if cfg.split_size <= 1:
             from mpi4dl_tpu.train import make_train_step
@@ -148,6 +155,7 @@ def build_train(cfg: ParallelConfig, family: str, mesh):
         step = make_pipeline_train_step(
             part, opt, mesh, cfg.parts, compute_dtype=dtype, remat=cfg.remat,
             from_probs=from_probs, with_data_axis=dp > 1, donate=True,
+            schedule=cfg.schedule,
         )
         state = init_pipeline_state(part, params, opt, mesh)
         return (
@@ -174,7 +182,7 @@ def build_train(cfg: ParallelConfig, family: str, mesh):
         step = make_gems_train_step(
             part, opt, mesh, cfg.parts, times=cfg.times, compute_dtype=dtype,
             remat=cfg.remat, from_probs=from_probs, with_data_axis=dp > 1,
-            donate=True,
+            donate=True, schedule=cfg.schedule,
         )
         state = init_pipeline_state(part, params, opt, mesh)
         return (
@@ -221,12 +229,13 @@ def build_train(cfg: ParallelConfig, family: str, mesh):
         step = make_sp_gems_train_step(
             spp, opt, mesh, cfg.parts, times=cfg.times, compute_dtype=dtype,
             remat=cfg.remat, from_probs=from_probs, with_data_axis=dp > 1,
-            donate=True,
+            donate=True, schedule=cfg.schedule,
         )
     else:
         step = make_sp_pipeline_train_step(
             spp, opt, mesh, cfg.parts, compute_dtype=dtype, remat=cfg.remat,
             from_probs=from_probs, with_data_axis=dp > 1, donate=True,
+            schedule=cfg.schedule,
         )
     state = init_sp_pipeline_state(spp, params, opt, mesh)
     return (
@@ -286,7 +295,15 @@ def _open_telemetry(directory, family, cfg, spec, step, state, dataset,
         x, y = dataset.batch(0, global_batch)
         compiled = step.lower(state, x, y).compile()
         cost = compiled_cost(compiled)
-        coll = hlo_collective_stats(compiled.as_text())
+        hlo_text = compiled.as_text()
+        coll = hlo_collective_stats(hlo_text)
+        # Schedule fingerprint: which per-tick scopes the compiled program
+        # carries (obs/report.py renders them on the `pipeline:` line).
+        tick_scopes = sorted(
+            s for s in ("gpipe_scan", "pp_1f1b_scan", "gems_dual_scan",
+                        "gems_1f1b_scan", "tail_scan", "fwd_tick", "bwd_tick")
+            if s in hlo_text
+        )
         # Cost-model flops are PER DEVICE (the one SPMD module every device
         # executes), so the report's MFU divides by one device's peak.
         peak, src = peak_flops(jax.devices()[0], allow_cpu_nominal=True)
@@ -298,6 +315,7 @@ def _open_telemetry(directory, family, cfg, spec, step, state, dataset,
                 cost["flops"], cost["bytes_accessed"]
             ),
             collectives=coll,
+            tick_scopes=tick_scopes,
             peak_flops=peak,
             peak_source=src,
             device_count=len(jax.devices()),

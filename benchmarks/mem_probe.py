@@ -1,13 +1,30 @@
-"""Compile-only peak-HBM probe for a bench rung configuration.
+"""Compile-only peak-HBM probe: single-chip rung configs AND the PP engine
+families.
 
 Asks XLA (via ``compiled.memory_analysis()``) what a training step's peak
 device memory is WITHOUT running it — the fast way to chart the memory
 frontier (ResNet-110-v2 2048², AmoebaNet 3328²+) against the ~15.75 GB
 usable HBM of a 16 GB chip, and to A/B memory levers (boundary packing,
-remat grouping) without burning a full rung timeout per point.
+remat grouping, pipeline schedules) without burning a full rung timeout per
+point.
+
+Single-chip rung (the original mode):
 
     python benchmarks/mem_probe.py --arch resnet --image-size 2048 \
         --num-layers 110 --remat sqrt --scan 1
+
+PP engine families (``--family lp|gems|sp|gems_sp``) build the same train
+step the benchmark runner would (benchmarks/common.build_train) on a
+self-provisioned virtual mesh and emit one row per schedule —
+``--schedule both`` is the gpipe-vs-1f1b peak-HBM table the 1F1B work is
+judged by (docs/pipeline.md):
+
+    python benchmarks/mem_probe.py --family lp --schedule both \
+        --image-size 256 --num-layers 11 --split-size 2 --parts 8 --batch 8
+
+``--telemetry-dir`` mirrors the table into a RunLog JSONL as a ``mem_probe``
+record (rendered by ``python -m mpi4dl_tpu.obs report``); ``--require-1f1b-win``
+exits 1 unless the 1f1b row's peak is strictly below gpipe's — the CI gate.
 """
 
 from __future__ import annotations
@@ -18,8 +35,116 @@ import os
 import sys
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-def main() -> None:
+
+def _mem_row(compiled, compile_s: float) -> dict:
+    ma = compiled.memory_analysis()
+    row = {"compile_s": round(compile_s, 1)}
+    for k in ("temp_size_in_bytes", "argument_size_in_bytes",
+              "output_size_in_bytes", "alias_size_in_bytes",
+              "generated_code_size_in_bytes"):
+        v = getattr(ma, k, None)
+        if v is not None:
+            row[k] = int(v)
+    temp = row.get("temp_size_in_bytes", 0)
+    arg = row.get("argument_size_in_bytes", 0)
+    alias = row.get("alias_size_in_bytes", 0)
+    # Peak live ≈ args + temps (donated args counted once via alias).
+    row["peak_gb_est"] = round((temp + arg - alias) / 2**30, 3)
+    return row
+
+
+def _probe_single(args) -> dict:
+    from bench import build_probe_setup
+
+    step, state, x, y = build_probe_setup(
+        args.image_size, args.num_layers, args.num_filters, args.batch,
+        remat=args.remat, scan=args.scan, arch=args.arch,
+    )
+    t0 = time.perf_counter()
+    compiled = step.lower(state, x, y).compile()
+    return {
+        "config": vars(args),
+        **_mem_row(compiled, time.perf_counter() - t0),
+    }
+
+
+def _probe_family(args) -> dict:
+    """One row per schedule for a PP engine family, built exactly as the
+    benchmark runner builds it (same cfg vocabulary, same mesh math)."""
+    import jax
+
+    from benchmarks.common import _ensure_devices, build_train
+    from mpi4dl_tpu.config import ParallelConfig
+    from mpi4dl_tpu.mesh import MeshSpec, build_mesh
+
+    schedules = (
+        ["gpipe", "1f1b"] if args.schedule == "both" else [args.schedule]
+    )
+    rows = {}
+    spec = None
+    for schedule in schedules:
+        cfg = ParallelConfig(
+            model=args.arch if args.arch != "amoeba" else "amoebanet",
+            batch_size=args.batch,
+            parts=args.parts,
+            split_size=args.split_size,
+            schedule=schedule,
+            # The engines checkpoint whole stages, so the single-chip remat
+            # vocabulary collapses to on/off here; --scan is a single-chip
+            # rung knob with no family equivalent (both recorded effective
+            # below so the table says what was actually probed).
+            remat=args.remat != "none",
+            times=args.times,
+            spatial_size=args.spatial_size,
+            num_spatial_parts=(args.num_spatial_parts,),
+            image_size=args.image_size,
+            num_layers=args.num_layers,
+            num_filters=args.num_filters,
+            num_classes=args.num_classes,
+        )
+        spec = (
+            MeshSpec.from_config(cfg)
+            if args.family in ("sp", "gems_sp")
+            else MeshSpec(stage=max(cfg.split_size, 1))
+        )
+        _ensure_devices(spec.size)
+        mesh = build_mesh(spec, jax.devices()[:spec.size])
+        step, state, _, global_batch = build_train(cfg, args.family, mesh)
+        import jax.numpy as jnp
+
+        x = jnp.zeros(
+            (global_batch, args.image_size, args.image_size, 3), jnp.float32
+        )
+        y = jnp.zeros((global_batch,), jnp.int32)
+        t0 = time.perf_counter()
+        compiled = step.lower(state, x, y).compile()
+        rows[schedule] = _mem_row(compiled, time.perf_counter() - t0)
+        print(
+            f"[mem_probe] {args.family}/{schedule}: "
+            f"{rows[schedule]['peak_gb_est']} GB peak "
+            f"({rows[schedule]['compile_s']}s compile)",
+            file=sys.stderr,
+        )
+    out = {
+        "metric": "mem_probe_peak_gb",
+        "family": args.family,
+        "mesh": str(spec),
+        "config": {**vars(args), "remat": args.remat != "none", "scan": None},
+        "schedules": rows,
+    }
+    if len(rows) == 2:
+        g, f = rows["gpipe"]["peak_gb_est"], rows["1f1b"]["peak_gb_est"]
+        out["win_1f1b_gb"] = round(g - f, 3)
+        out["table"] = (
+            f"schedule  peak_gb\ngpipe     {g}\n1f1b      {f}\n"
+            f"1f1b win  {round(g - f, 3)} GB"
+        )
+    return out
+
+
+def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--image-size", type=int, default=2048)
     p.add_argument("--num-layers", type=int, default=110)
@@ -29,39 +154,65 @@ def main() -> None:
                    choices=["none", "cell", "fine", "sqrt"])
     p.add_argument("--arch", default="resnet", choices=["amoeba", "resnet"])
     p.add_argument("--scan", type=int, default=1)
-    args = p.parse_args()
+    p.add_argument("--family", default="single",
+                   choices=["single", "lp", "gems", "sp", "gems_sp"],
+                   help="'single' probes a one-chip rung (bench.py path); "
+                        "the engine families probe the PP train step on a "
+                        "virtual mesh")
+    p.add_argument("--schedule", default="both",
+                   choices=["gpipe", "1f1b", "both"],
+                   help="pipeline schedule(s) to probe (family mode)")
+    p.add_argument("--split-size", type=int, default=2)
+    p.add_argument("--parts", type=int, default=4)
+    p.add_argument("--times", type=int, default=1)
+    p.add_argument("--spatial-size", type=int, default=1)
+    p.add_argument("--num-spatial-parts", type=int, default=2)
+    p.add_argument("--num-classes", type=int, default=10)
+    p.add_argument("--telemetry-dir", default=None,
+                   help="mirror the result into a RunLog JSONL as a "
+                        "mem_probe record (docs/observability.md)")
+    p.add_argument("--require-1f1b-win", action="store_true",
+                   help="exit 1 unless 1f1b peak < gpipe peak (needs "
+                        "--schedule both)")
+    p.add_argument("--out", default=None, help="also write the JSON here")
+    args = p.parse_args(argv)
 
     import jax
 
-    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    from bench import build_probe_setup
+    print(f"[mem_probe] device={jax.devices()[0] if args.family == 'single' else 'virtual mesh'}",
+          file=sys.stderr)
+    if args.family == "single":
+        out = _probe_single(args)
+    else:
+        out = _probe_family(args)
 
-    dev = jax.devices()[0]
-    print(f"[mem_probe] device={dev}", file=sys.stderr)
-    step, state, x, y = build_probe_setup(
-        args.image_size, args.num_layers, args.num_filters, args.batch,
-        remat=args.remat, scan=args.scan, arch=args.arch,
-    )
-    t0 = time.perf_counter()
-    compiled = step.lower(state, x, y).compile()
-    ma = compiled.memory_analysis()
-    out = {
-        "config": vars(args),
-        "compile_s": round(time.perf_counter() - t0, 1),
-    }
-    for k in ("temp_size_in_bytes", "argument_size_in_bytes",
-              "output_size_in_bytes", "alias_size_in_bytes",
-              "generated_code_size_in_bytes"):
-        v = getattr(ma, k, None)
-        if v is not None:
-            out[k] = int(v)
-    temp = out.get("temp_size_in_bytes", 0)
-    arg = out.get("argument_size_in_bytes", 0)
-    alias = out.get("alias_size_in_bytes", 0)
-    # Peak live ≈ args + temps (donated args counted once via alias).
-    out["peak_gb_est"] = round((temp + arg - alias) / 2**30, 3)
-    print(json.dumps(out))
+    line = json.dumps(out)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line)
+    if args.telemetry_dir:
+        from mpi4dl_tpu.obs import RunLog
+
+        runlog = RunLog.create(args.telemetry_dir, prefix="mem_probe")
+        runlog.write_meta(config=out.get("config") or vars(args),
+                          family=args.family,
+                          argv=list(argv) if argv is not None else sys.argv[1:])
+        runlog.write("mem_probe", **out)
+        runlog.close()
+        print(f"[mem_probe] telemetry written to {runlog.path}",
+              file=sys.stderr)
+    if args.require_1f1b_win:
+        win = out.get("win_1f1b_gb")
+        if win is None or win <= 0:
+            print(
+                f"[mem_probe] FAIL: 1f1b does not win (win_1f1b_gb={win})",
+                file=sys.stderr,
+            )
+            return 1
+        print(f"[mem_probe] OK: 1f1b wins by {win} GB", file=sys.stderr)
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    raise SystemExit(main())

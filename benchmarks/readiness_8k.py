@@ -45,6 +45,9 @@ def main(argv=None) -> int:
     p.add_argument("--tiles", type=int, default=4, help="spatial grid per dim")
     p.add_argument("--stages", type=int, default=2)
     p.add_argument("--parts", type=int, default=1)
+    p.add_argument("--schedule", choices=["gpipe", "1f1b"], default="gpipe",
+                   help="pipeline-tail schedule (1f1b bounds live tail "
+                        "micro-batches to O(stages); docs/pipeline.md)")
     p.add_argument("--num-layers", type=int, default=18)
     p.add_argument("--num-filters", type=int, default=416)
     p.add_argument("--spatial-until", type=int, default=9,
@@ -118,7 +121,7 @@ def main(argv=None) -> int:
                            junction="gather")
     step = make_sp_pipeline_train_step(
         spp, opt, mesh, parts=args.parts, compute_dtype=jnp.bfloat16,
-        remat=True, donate=True,
+        remat=True, donate=True, schedule=args.schedule,
     )
     state = init_sp_pipeline_state(spp, params, opt, mesh)
     x = jnp.zeros((args.parts * 1, px, px, 3), jnp.bfloat16)
@@ -154,7 +157,8 @@ def main(argv=None) -> int:
         "unit": "GB/device",
         "config": {
             "image_size": px, "grid": f"{t}x{t}", "stages": S,
-            "parts": args.parts, "devices": n_dev,
+            "parts": args.parts, "schedule": args.schedule,
+            "devices": n_dev,
             "model": f"amoebanetd({args.num_layers},{args.num_filters})",
         },
         "compile_seconds": round(compile_s, 1),

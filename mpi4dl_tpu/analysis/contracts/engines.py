@@ -18,7 +18,15 @@ from __future__ import annotations
 
 from typing import Tuple
 
-ENGINE_FAMILIES: Tuple[str, ...] = ("lp", "sp", "gems", "gems_sp")
+# The ``*_1f1b`` variants build the SAME frozen configuration under
+# ``schedule="1f1b"`` (the manual-backward one-forward-one-backward tick
+# loop) — their goldens pin the schedule's collective structure (two
+# ppermute handoffs per tick under fwd_tick/bwd_tick scopes) independently
+# of the GPipe goldens, which must not drift when the flag is off.
+ENGINE_FAMILIES: Tuple[str, ...] = (
+    "lp", "sp", "gems", "gems_sp",
+    "lp_1f1b", "sp_1f1b", "gems_1f1b", "gems_sp_1f1b",
+)
 
 # Frozen build constants (see module docstring before touching these).
 _DEPTH = 11
@@ -32,9 +40,18 @@ _SPW = 2
 _SEED = 0
 
 
+def base_family(family: str) -> str:
+    """Strip the ``_1f1b`` schedule suffix off a contract family name."""
+    return family[: -len("_1f1b")] if family.endswith("_1f1b") else family
+
+
 def required_devices(family: str) -> int:
     """Virtual-mesh device count the family's canonical build needs."""
-    return _STAGES * _SPW if family in ("sp", "gems_sp") else _STAGES
+    return (
+        _STAGES * _SPW
+        if base_family(family) in ("sp", "gems_sp")
+        else _STAGES
+    )
 
 
 def build_engine(family: str):
@@ -55,6 +72,8 @@ def build_engine(family: str):
     if family not in ENGINE_FAMILIES:
         raise ValueError(f"unknown engine family {family!r}; "
                          f"have {ENGINE_FAMILIES}")
+    schedule = "1f1b" if family.endswith("_1f1b") else "gpipe"
+    family = base_family(family)
 
     batch = _GEMS_SP_BATCH if family == "gems_sp" else _BATCH
     model = get_resnet_v2((batch, _PX, _PX, 3), depth=_DEPTH,
@@ -76,12 +95,13 @@ def build_engine(family: str):
         if family == "lp":
             from mpi4dl_tpu.parallel.pipeline import make_pipeline_train_step
 
-            step = make_pipeline_train_step(part, opt, mesh, parts=_PARTS)
+            step = make_pipeline_train_step(part, opt, mesh, parts=_PARTS,
+                                            schedule=schedule)
         else:
             from mpi4dl_tpu.parallel.gems import make_gems_train_step
 
             step = make_gems_train_step(part, opt, mesh, parts=_PARTS,
-                                        times=1)
+                                        times=1, schedule=schedule)
         state = init_pipeline_state(part, params, opt, mesh)
         return step, (state, x, y)
 
@@ -102,8 +122,10 @@ def build_engine(family: str):
     spp = SPPipeline.build(model, params, _STAGES, sp, micro,
                            junction="gather")
     if family == "sp":
-        step = make_sp_pipeline_train_step(spp, opt, mesh, parts=_PARTS)
+        step = make_sp_pipeline_train_step(spp, opt, mesh, parts=_PARTS,
+                                           schedule=schedule)
     else:
-        step = make_sp_gems_train_step(spp, opt, mesh, parts=_PARTS, times=1)
+        step = make_sp_gems_train_step(spp, opt, mesh, parts=_PARTS, times=1,
+                                       schedule=schedule)
     state = init_sp_pipeline_state(spp, params, opt, mesh)
     return step, (state, x, y)

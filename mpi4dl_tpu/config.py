@@ -45,6 +45,12 @@ HATCHES: Dict[str, Hatch] = {
               "1 = per-op checkpoints inside composite cells under ANY outer "
               "remat level (the ResNet-2048 memory frontier; bench auto-"
               "retries with it on OOM)."),
+        Hatch("MPI4DL_1F1B_CELL_REMAT", "auto",
+              "Per-cell checkpoints inside the 1F1B backward branches: "
+              "1 = force on, 0 = force off, auto = on only for short stages "
+              "(<= 3 cells — measured crossover, docs/pipeline.md; deep "
+              "stages schedule the per-cell recomputes concurrently and "
+              "regress peak HBM several-fold)."),
         Hatch("MPI4DL_NO_PHASE_DX", "0",
               "1 = strided convs keep XLA's lhs-dilation backward instead of "
               "the phase-decomposed dx path."),
@@ -124,6 +130,11 @@ class ParallelConfig:
     batch_size: int = 32
     parts: int = 1  # micro-batches per step (GPipe "parts")
     split_size: int = 1  # number of pipeline stages (LP splits)
+    # Pipeline schedule: 'gpipe' (all-forward-then-all-backward, the
+    # exactness oracle) or '1f1b' (one-forward-one-backward with a manual
+    # schedule-level backward — O(stages) live activations instead of
+    # O(parts); docs/pipeline.md).  Ignored by non-pipeline families.
+    schedule: str = "gpipe"
     num_spatial_parts: Tuple[int, ...] = (4,)  # comma-list in the reference
     spatial_size: int = 1  # how many leading splits are spatial
     times: int = 1  # GEMS replication factor ("--times")
@@ -242,6 +253,9 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch-size", type=int, default=32)
     p.add_argument("--parts", type=int, default=1)
     p.add_argument("--split-size", type=int, default=1)
+    p.add_argument("--schedule", choices=["gpipe", "1f1b"], default="gpipe",
+                   help="pipeline schedule: gpipe (default) or 1f1b "
+                        "(O(stages) live activations; docs/pipeline.md)")
     p.add_argument("--num-spatial-parts", type=str, default="4")
     p.add_argument("--spatial-size", type=int, default=1)
     p.add_argument("--times", type=int, default=1)
@@ -301,6 +315,7 @@ def config_from_args(args: argparse.Namespace) -> ParallelConfig:
         batch_size=args.batch_size,
         parts=args.parts,
         split_size=args.split_size,
+        schedule=args.schedule,
         num_spatial_parts=_int_tuple(args.num_spatial_parts) or (4,),
         spatial_size=args.spatial_size,
         times=args.times,

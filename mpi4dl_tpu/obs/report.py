@@ -120,6 +120,39 @@ def render_run(path: str) -> str:
     else:
         lines.append("memory watermark: n/a")
 
+    # -- pipeline schedule -------------------------------------------------
+    # Keyed on the meta family, not just split_size: tools that record raw
+    # argparse defaults (mem_probe's single-chip mode carries
+    # --split-size 2) must not render a pipeline line for a run without one.
+    cfg = (meta.get("config") or {}) if meta is not None else {}
+    split = int(cfg.get("split_size") or 1)
+    if split > 1 and (meta or {}).get("family") != "single":
+        parts_n = int(cfg.get("parts") or 1)
+        schedule = cfg.get("schedule") or "gpipe"
+        if schedule == "1f1b":
+            # One fwd AND one bwd micro-batch per tick; fill+drain covers
+            # both directions.
+            ticks = parts_n + 2 * (split - 1)
+            bubble = 2 * (split - 1) / (parts_n + 2 * (split - 1))
+        elif schedule == "gpipe":
+            ticks = parts_n + split - 1
+            bubble = (split - 1) / ticks
+        else:
+            # Not a schedule the tick arithmetic knows (e.g. mem_probe's
+            # multi-schedule sweeps record schedule="both") — don't render
+            # one schedule's numbers under another's name.
+            ticks = None
+            bubble = None
+        line = f"pipeline: schedule={schedule}  stages={split}  parts={parts_n}"
+        if ticks is not None:
+            line += f"  ticks/step={ticks}  bubble={bubble:.3f}"
+        # Corroborate from the compiled program when the cost record saw it:
+        # tick scopes are the schedule's fingerprint in the HLO op names.
+        scopes_seen = (cost or {}).get("tick_scopes")
+        if scopes_seen:
+            line += "  scopes: " + ",".join(scopes_seen)
+        lines.append(line)
+
     # -- retraces ----------------------------------------------------------
     sizes = [r.get("jit_cache_size") for r in steps
              if r.get("jit_cache_size") is not None]

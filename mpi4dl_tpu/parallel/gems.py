@@ -46,8 +46,13 @@ from mpi4dl_tpu.parallel.partition import StagePartition
 from mpi4dl_tpu.parallel.pipeline import PipelineState
 from mpi4dl_tpu.parallel.stage_common import (
     gems_dual_scan,
+    make_gems_1f1b_scan,
     make_stage_branches,
+    restore_opt_rows,
     scatter_stage_stats,
+    squeeze_opt_rows,
+    stage_opt_specs,
+    use_1f1b_cell_remat,
 )
 from mpi4dl_tpu.train import Optimizer
 from mpi4dl_tpu.mesh import AXIS_DATA, AXIS_STAGE
@@ -65,9 +70,17 @@ def make_gems_train_step(
     with_data_axis: bool = False,
     bn_stats: bool = True,
     donate: bool = False,
+    schedule: str = "gpipe",
 ):
     """Build the GEMS step: x is [2 * times * parts * mb, H, W, C]; the first
-    half of each pair flows forward, the second backward."""
+    half of each pair flows forward, the second backward.
+
+    ``schedule="1f1b"`` swaps the dual tick loop for its manual-backward
+    1F1B counterpart (stage_common.make_gems_1f1b_scan) — the mirror streams
+    keep interleaving, with both streams' cotangent ppermutes riding the
+    same ticks as the activations."""
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown schedule {schedule!r}; use 'gpipe' or '1f1b'")
     S = part.num_stages
     Pn = parts
     ctx = ApplyCtx(train=True)
@@ -76,12 +89,25 @@ def make_gems_train_step(
 
     with_stats = bn_stats and part.stat_max > 0
     branches = make_stage_branches(
-        part, ctx, compute_dtype, remat, with_stats,
+        part, ctx, compute_dtype, remat and schedule == "gpipe", with_stats,
         vary_axes=(AXIS_STAGE,) + grad_axes,
+        cell_remat=schedule == "1f1b" and use_1f1b_cell_remat(part),
+    )
+    scan_1f1b = (
+        make_gems_1f1b_scan(
+            part, branches,
+            vary_axes=(AXIS_STAGE,) + grad_axes,
+            from_probs=from_probs, compute_dtype=compute_dtype,
+        )
+        if schedule == "1f1b"
+        else None
     )
 
     def sharded_step(param_row, opt_state, x, labels):
         flat_params = param_row[0]
+        # Stage-sharded opt rows squeeze like the param row; replicated
+        # scalar leaves pass through (see pipeline.py).
+        opt_local = squeeze_opt_rows(opt_state)
         groups = 2 * times
         mb = x.shape[0] // (groups * Pn)
         # [times, 2, parts, mb, ...]
@@ -94,13 +120,19 @@ def make_gems_train_step(
                 mirror_params = lax.ppermute(
                     flat_params, AXIS_STAGE, mirror_perm
                 )
-            with scope("gems_dual_scan"):
-                loss_acc, acc_acc, stA, stB = gems_dual_scan(
-                    part, branches, flat_params, mirror_params, xs, ys,
-                    vary_axes=(AXIS_STAGE,) + grad_axes,
-                    from_probs=from_probs,
-                    compute_dtype=compute_dtype,
-                )
+            if schedule == "1f1b":
+                with scope("gems_1f1b_scan"):
+                    loss_acc, acc_acc, stA, stB = scan_1f1b(
+                        flat_params, mirror_params, xs, ys
+                    )
+            else:
+                with scope("gems_dual_scan"):
+                    loss_acc, acc_acc, stA, stB = gems_dual_scan(
+                        part, branches, flat_params, mirror_params, xs, ys,
+                        vary_axes=(AXIS_STAGE,) + grad_axes,
+                        from_probs=from_probs,
+                        compute_dtype=compute_dtype,
+                    )
             denom = 2 * times * Pn
             loss = lax.psum(loss_acc, AXIS_STAGE) / denom
             acc = lax.psum(acc_acc, AXIS_STAGE) / denom
@@ -119,20 +151,25 @@ def make_gems_train_step(
         if grad_axes:
             grads = lax.pmean(grads, grad_axes)
         with scope("optimizer_update"):
-            new_flat, new_opt = optimizer.update(flat_params, grads, opt_state)
+            new_flat, new_opt = optimizer.update(flat_params, grads, opt_local)
         if with_stats:
             if grad_axes:
                 stats = lax.pmean(stats, grad_axes)
             new_flat = scatter_stage_stats(part, new_flat, stats)
-        return new_flat[None], new_opt, {"loss": loss, "accuracy": acc}
+        return (
+            new_flat[None],
+            restore_opt_rows(new_opt, opt_state),
+            {"loss": loss, "accuracy": acc},
+        )
 
     pspec = P(AXIS_STAGE, None)
+    ospec = stage_opt_specs(optimizer, part)
     dspec = P(AXIS_DATA) if with_data_axis else P()
     smapped = shard_map(
         sharded_step,
         mesh=mesh,
-        in_specs=(pspec, pspec, dspec, dspec),
-        out_specs=(pspec, pspec, P()),
+        in_specs=(pspec, ospec, dspec, dspec),
+        out_specs=(pspec, ospec, P()),
     )
 
     @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())

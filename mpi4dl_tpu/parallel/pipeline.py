@@ -16,12 +16,16 @@ micro-batch "parts" all-forward-then-all-backward
   compute on don't-care data and are masked out of the loss — the same
   wall-clock the reference's idle bubbles cost, with no control-flow
   divergence in the compiled program.
-- **The backward pass is jax.grad of the scan.**  AD transposes the forward
-  ppermute into the reverse-direction cotangent ppermute (the reference's
-  explicit grad send/recv chain, mp_pipeline.py:365-432) and replays ticks in
-  reverse order — all-forward-then-all-backward falls out, with per-stage
-  rematerialisation (jax.checkpoint) bounding activation memory exactly like
-  GPipe.
+- **The backward pass is jax.grad of the scan** (``schedule="gpipe"``, the
+  default).  AD transposes the forward ppermute into the reverse-direction
+  cotangent ppermute (the reference's explicit grad send/recv chain,
+  mp_pipeline.py:365-432) and replays ticks in reverse order —
+  all-forward-then-all-backward falls out, with per-stage rematerialisation
+  (jax.checkpoint) bounding activation memory exactly like GPipe.
+- ``schedule="1f1b"`` replaces the AD replay with a schedule-level manual
+  backward (stage_common.make_1f1b_scan): each tick runs one forward AND
+  one backward micro-batch, bounding live activations to O(stages) instead
+  of the replay's O(parts) tick carries (docs/pipeline.md).
 
 No recv buffers, no tags, no GEMS_INVERSE rank mirroring — placement is the
 mesh, ordering is dataflow.
@@ -45,8 +49,14 @@ from mpi4dl_tpu.obs.scopes import scope
 from mpi4dl_tpu.parallel.partition import StagePartition
 from mpi4dl_tpu.parallel.stage_common import (
     gpipe_scan,
+    make_1f1b_scan,
     make_stage_branches,
+    put_stage_opt,
+    restore_opt_rows,
     scatter_stage_stats,
+    squeeze_opt_rows,
+    stage_opt_specs,
+    use_1f1b_cell_remat,
 )
 from mpi4dl_tpu.train import Optimizer
 from mpi4dl_tpu.mesh import AXIS_DATA, AXIS_STAGE
@@ -78,11 +88,23 @@ def make_pipeline_train_step(
     loss_scale: float = 1.0,
     bn_stats: bool = True,
     donate: bool = False,
+    schedule: str = "gpipe",
 ):
     """Build `(PipelineState, x, labels) -> (PipelineState, metrics)`.
 
     x: [B, H, W, C] global batch (B = parts * microbatch); labels: [B].
+
+    ``schedule``: ``"gpipe"`` (default — all-forward-then-all-backward as
+    jax.grad of the tick scan, the exactness oracle) or ``"1f1b"`` (the
+    one-forward-one-backward schedule with a schedule-level manual backward,
+    stage_common.make_1f1b_scan: O(stages) live activations instead of
+    O(parts)).  Both produce the same parameters after a step up to
+    accumulation-order rounding; 1F1B always recomputes stage forwards
+    inside its backward branches, so ``remat`` is moot there (branches are
+    built unwrapped).  docs/pipeline.md covers when to pick which.
     """
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown schedule {schedule!r}; use 'gpipe' or '1f1b'")
     S = part.num_stages
     Pn = parts
     ctx = ApplyCtx(train=True)
@@ -90,25 +112,46 @@ def make_pipeline_train_step(
     grad_axes: Tuple[str, ...] = (AXIS_DATA,) if with_data_axis else ()
     with_stats = bn_stats and part.stat_max > 0
     branches = make_stage_branches(
-        part, ctx, compute_dtype, remat, with_stats,
+        part, ctx, compute_dtype, remat and schedule == "gpipe", with_stats,
         vary_axes=(AXIS_STAGE,) + grad_axes,
+        cell_remat=schedule == "1f1b" and use_1f1b_cell_remat(part),
+    )
+    scan_1f1b = (
+        make_1f1b_scan(
+            part, branches,
+            vary_axes=(AXIS_STAGE,) + grad_axes,
+            from_probs=from_probs, compute_dtype=compute_dtype,
+            seed_scale=loss_scale,
+        )
+        if schedule == "1f1b"
+        else None
     )
 
     def sharded_step(param_row, opt_state, x, labels):
-        # param_row: [1, Pmax] local stage block; squeeze to [Pmax].
+        # param_row: [1, Pmax] local stage block; squeeze to [Pmax] (the
+        # optimizer-state moment rows get the same treatment; Adam's
+        # replicated scalar step counter passes through — stage_common.
+        # squeeze_opt_rows).
         flat_params = param_row[0]
+        opt_local = squeeze_opt_rows(opt_state)
         mb = x.shape[0] // Pn
         x_parts = x.reshape(Pn, mb, *x.shape[1:]).astype(compute_dtype)
         y_parts = labels.reshape(Pn, mb)
 
         def loss_and_metrics(flat_params):
-            with scope("gpipe_scan"):
-                loss_acc, acc_acc, st_acc = gpipe_scan(
-                    part, branches, flat_params, x_parts, y_parts,
-                    vary_axes=(AXIS_STAGE,) + grad_axes,
-                    from_probs=from_probs,
-                    compute_dtype=compute_dtype,
-                )
+            if schedule == "1f1b":
+                with scope("pp_1f1b_scan"):
+                    loss_acc, acc_acc, st_acc = scan_1f1b(
+                        flat_params, x_parts, y_parts
+                    )
+            else:
+                with scope("gpipe_scan"):
+                    loss_acc, acc_acc, st_acc = gpipe_scan(
+                        part, branches, flat_params, x_parts, y_parts,
+                        vary_axes=(AXIS_STAGE,) + grad_axes,
+                        from_probs=from_probs,
+                        compute_dtype=compute_dtype,
+                    )
             # Only the last stage accumulated; psum broadcasts to all stages
             # (and sums over data-parallel groups' mean below).
             loss = lax.psum(loss_acc, AXIS_STAGE) / Pn
@@ -127,20 +170,25 @@ def make_pipeline_train_step(
         if grad_axes:
             grads = lax.pmean(grads, grad_axes)
         with scope("optimizer_update"):
-            new_flat, new_opt = optimizer.update(flat_params, grads, opt_state)
+            new_flat, new_opt = optimizer.update(flat_params, grads, opt_local)
         if with_stats:
             if grad_axes:
                 stats = lax.pmean(stats, grad_axes)
             new_flat = scatter_stage_stats(part, new_flat, stats)
-        return new_flat[None], new_opt, {"loss": loss, "accuracy": acc}
+        return (
+            new_flat[None],
+            restore_opt_rows(new_opt, opt_state),
+            {"loss": loss, "accuracy": acc},
+        )
 
     pspec = P(AXIS_STAGE, None)
+    ospec = stage_opt_specs(optimizer, part)
     dspec = P(AXIS_DATA) if with_data_axis else P()
     smapped = shard_map(
         sharded_step,
         mesh=mesh,
-        in_specs=(pspec, pspec, dspec, dspec),
-        out_specs=(pspec, pspec, P()),
+        in_specs=(pspec, ospec, dspec, dspec),
+        out_specs=(pspec, ospec, P()),
     )
 
     # donate=True: param/opt buffers update in place (one copy, not two, of
@@ -162,7 +210,7 @@ def init_pipeline_state(
     buf = part.pack_params(params_list)
     sharding = NamedSharding(mesh, P(AXIS_STAGE, None))
     buf = jax.device_put(buf, sharding)
-    opt_state = jax.tree.map(
-        lambda z: jax.device_put(z, sharding), optimizer.init(buf)
-    )
+    # Moment buffers ride the stage sharding; scalar leaves (Adam's step
+    # counter) are replicated — same rule as the engines' shard_map specs.
+    opt_state = put_stage_opt(optimizer.init(buf), mesh)
     return PipelineState(buf, opt_state, jnp.zeros((), jnp.int32))

@@ -25,9 +25,12 @@ branches must be pure compute):
   ``all_gather`` over ``stage`` lines junction activations up in micro-batch
   injection order.
 - **PP phase**: the shared GPipe tick scan (stage_common.gpipe_scan) over the
-  tail cells.  The backward pass of BOTH phases is one jax.grad through the
-  whole program: the junction gathers transpose into the tile/stage scatter
-  of cotangents the reference implements by hand.
+  tail cells — or, under ``schedule="1f1b"``, the manual-backward 1F1B tick
+  loop (stage_common.make_1f1b_scan; docs/pipeline.md).  The backward pass
+  of BOTH phases is one jax.grad through the whole program: the junction
+  gathers transpose into the tile/stage scatter of cotangents the reference
+  implements by hand (the 1F1B scan's custom_vjp hands AD the tail-injection
+  cotangents, so the same transposes fire either way).
 
 Gradient combine — DERIVATION (validated exactly against single-device SGD
 in tests/test_sp_pipeline.py for both junctions):
@@ -77,8 +80,15 @@ from mpi4dl_tpu.parallel.spatial import (
 from mpi4dl_tpu.parallel.stage_common import (
     gems_dual_scan,
     gpipe_scan,
+    make_1f1b_scan,
+    make_gems_1f1b_scan,
     make_stage_branches,
+    put_stage_opt,
+    restore_opt_rows,
     scatter_stage_stats,
+    squeeze_opt_rows,
+    stage_opt_specs,
+    use_1f1b_cell_remat,
 )
 from mpi4dl_tpu.train import Optimizer, spatial_partition_spec
 from mpi4dl_tpu.mesh import AXIS_DATA, AXIS_STAGE
@@ -209,9 +219,9 @@ def init_sp_pipeline_state(
     tail_buf = jax.device_put(spp.tail_part.pack_params(params_list[spp.spatial_until:]),
                               tail_sharding)
     opt_sp = optimizer.init(sp_buf)
-    opt_tail = jax.tree.map(
-        lambda z: jax.device_put(z, tail_sharding), optimizer.init(tail_buf)
-    )
+    # Tail moment rows ride the stage sharding; scalar leaves (Adam's step
+    # counter) are replicated — same rule as _make_sp_step's shard_map specs.
+    opt_tail = put_stage_opt(optimizer.init(tail_buf), mesh)
     return SPPipelineState(sp_buf, tail_buf, opt_sp, opt_tail, jnp.zeros((), jnp.int32))
 
 
@@ -227,9 +237,18 @@ def _make_sp_step(
     with_data_axis: bool,
     bn_stats: bool = True,
     donate: bool = False,
+    schedule: str = "gpipe",
 ):
     """Shared scaffolding of the SP(+GEMS) x PP steps: phase-1 spatial region,
     junction, tail scan (``scan_fn``), loss reduction, grad combine, update.
+
+    ``schedule="1f1b"`` only affects how the tail branches are built here
+    (unwrapped — the 1F1B scans recompute stage forwards in their own
+    backward branches); the schedule itself lives in ``scan_fn``, whose
+    custom_vjp hands the tail-injection cotangents back to this function's
+    ``jax.value_and_grad``, which routes them through the junction/spatial
+    transposes exactly as the GPipe AD path does.  The spatial region keeps
+    its own remat setting either way.
 
     ``lead_shape`` shapes the injection pytree's leading dims —
     ``(Pn,)`` for GPipe, ``(times, 2, Pn)`` for the GEMS dual stream.
@@ -257,11 +276,15 @@ def _make_sp_step(
     sp_ctx = ApplyCtx(train=True, spatial=sp)
     tail_ctx = ApplyCtx(train=True)
 
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown schedule {schedule!r}; use 'gpipe' or '1f1b'")
     with_stats_sp = bn_stats and bool(spp.sp_stat_leaf_ids)
     with_stats_tail = bn_stats and part.stat_max > 0
     branches = make_stage_branches(
-        part, tail_ctx, compute_dtype, remat, with_stats_tail,
+        part, tail_ctx, compute_dtype, remat and schedule == "gpipe",
+        with_stats_tail,
         vary_axes=(AXIS_STAGE,) + tile_axes + grad_axes,
+        cell_remat=schedule == "1f1b" and use_1f1b_cell_remat(part),
     )
 
     def phase1(sp_flat, x_tile):
@@ -332,6 +355,10 @@ def _make_sp_step(
 
     def sharded_step(sp_buf, tail_row, opt_sp, opt_tail, x, labels):
         tail_flat = tail_row[0]
+        # Stage-sharded tail opt moment rows squeeze like the param row;
+        # scalar leaves pass through (see pipeline.py).  opt_sp is fully
+        # replicated and passes through whole.
+        opt_tail_local = squeeze_opt_rows(opt_tail)
         y_parts = labels_to_parts(labels)
         vary_axes = (AXIS_STAGE,) + tile_axes + grad_axes
 
@@ -368,7 +395,7 @@ def _make_sp_step(
         with scope("optimizer_update"):
             new_sp, new_opt_sp = optimizer.update(sp_buf, g_sp, opt_sp)
             new_tail, new_opt_tail = optimizer.update(
-                tail_flat, g_tail, opt_tail
+                tail_flat, g_tail, opt_tail_local
             )
         if with_stats_sp:
             # Spatial stats vary over stage (distinct batch chunks) and data;
@@ -392,18 +419,19 @@ def _make_sp_step(
             new_sp,
             new_tail[None],
             new_opt_sp,
-            new_opt_tail,
+            restore_opt_rows(new_opt_tail, opt_tail),
             {"loss": loss, "accuracy": acc},
         )
 
     x_spec = spatial_partition_spec(sp, data=with_data_axis)
     y_spec = P(AXIS_DATA) if with_data_axis else P()
     tail_spec = P(AXIS_STAGE, None)
+    tail_ospec = stage_opt_specs(optimizer, part)
     smapped = shard_map(
         sharded_step,
         mesh=mesh,
-        in_specs=(P(), tail_spec, P(), tail_spec, x_spec, y_spec),
-        out_specs=(P(), tail_spec, P(), tail_spec, P()),
+        in_specs=(P(), tail_spec, P(), tail_ospec, x_spec, y_spec),
+        out_specs=(P(), tail_spec, P(), tail_ospec, P()),
     )
 
     @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
@@ -430,6 +458,7 @@ def make_sp_pipeline_train_step(
     with_data_axis: bool = False,
     bn_stats: bool = True,
     donate: bool = False,
+    schedule: str = "gpipe",
 ):
     """Build `(SPPipelineState, x, labels) -> (SPPipelineState, metrics)`.
 
@@ -437,21 +466,40 @@ def make_sp_pipeline_train_step(
     Constraints: B % S == 0 (stage blocks take equal chunks) and, for
     junction='batch_split', (B/S) % tiles == 0 (each stage chunk splits over
     the tile grid) — both checked at trace time.
+
+    ``schedule="1f1b"`` runs the tail under the manual-backward 1F1B tick
+    loop (grad_x=True: the scan's custom_vjp returns the tail-injection
+    cotangents so AD can route them back through the junction into the
+    spatial region).
     """
     part = spp.tail_part
+    cache: dict = {}
 
     def scan_fn(branches, tail_flat, x_parts, y_parts, vary_axes):
-        loss_acc, acc_acc, st_acc = gpipe_scan(
-            part, branches, tail_flat, x_parts, y_parts,
-            vary_axes=vary_axes,
-            from_probs=from_probs,
-            compute_dtype=compute_dtype,
-        )
+        if schedule == "1f1b":
+            if "scan" not in cache:
+                cache["scan"] = make_1f1b_scan(
+                    part, branches,
+                    vary_axes=vary_axes,
+                    from_probs=from_probs,
+                    compute_dtype=compute_dtype,
+                    grad_x=True,
+                )
+            loss_acc, acc_acc, st_acc = cache["scan"](
+                tail_flat, x_parts, y_parts
+            )
+        else:
+            loss_acc, acc_acc, st_acc = gpipe_scan(
+                part, branches, tail_flat, x_parts, y_parts,
+                vary_axes=vary_axes,
+                from_probs=from_probs,
+                compute_dtype=compute_dtype,
+            )
         return loss_acc, acc_acc, st_acc / parts
 
     return _make_sp_step(
         spp, optimizer, mesh, (parts,), scan_fn, parts,
-        compute_dtype, remat, with_data_axis, bn_stats, donate,
+        compute_dtype, remat, with_data_axis, bn_stats, donate, schedule,
     )
 
 
@@ -467,6 +515,7 @@ def make_sp_gems_train_step(
     with_data_axis: bool = False,
     bn_stats: bool = True,
     donate: bool = False,
+    schedule: str = "gpipe",
 ):
     """SP x GEMS x PP — the reference's flagship 5D composition
     (``train_spatial_master.py``: two spatial models over mirrored rank sets
@@ -475,24 +524,42 @@ def make_sp_gems_train_step(
 
     x: [B, H, W, C] with B = 2 * times * parts * microbatch per data replica;
     pairs alternate direction through the tail stage chain.
+    ``schedule="1f1b"``: both mirror streams run one-forward-one-backward
+    (stage_common.make_gems_1f1b_scan, grad_x=True for the junction
+    transpose); the mirror-ppermute here stays outside the scan so AD still
+    routes stream B's gradients home.
     """
     part = spp.tail_part
     S = part.num_stages
     mirror_perm = [(i, S - 1 - i) for i in range(S)]
+    cache: dict = {}
 
     def scan_fn(branches, tail_flat, x_parts, y_parts, vary_axes):
         with scope("gems_mirror"):
             mirror_params = lax.ppermute(tail_flat, AXIS_STAGE, mirror_perm)
-        loss_acc, acc_acc, stA, stB = gems_dual_scan(
-            part, branches, tail_flat, mirror_params, x_parts, y_parts,
-            vary_axes=vary_axes,
-            from_probs=from_probs,
-            compute_dtype=compute_dtype,
-        )
+        if schedule == "1f1b":
+            if "scan" not in cache:
+                cache["scan"] = make_gems_1f1b_scan(
+                    part, branches,
+                    vary_axes=vary_axes,
+                    from_probs=from_probs,
+                    compute_dtype=compute_dtype,
+                    grad_x=True,
+                )
+            loss_acc, acc_acc, stA, stB = cache["scan"](
+                tail_flat, mirror_params, x_parts, y_parts
+            )
+        else:
+            loss_acc, acc_acc, stA, stB = gems_dual_scan(
+                part, branches, tail_flat, mirror_params, x_parts, y_parts,
+                vary_axes=vary_axes,
+                from_probs=from_probs,
+                compute_dtype=compute_dtype,
+            )
         st = (stA + lax.ppermute(stB, AXIS_STAGE, mirror_perm)) / (2 * times * parts)
         return loss_acc, acc_acc, st
 
     return _make_sp_step(
         spp, optimizer, mesh, (times, 2, parts), scan_fn, 2 * times * parts,
-        compute_dtype, remat, with_data_axis, bn_stats, donate,
+        compute_dtype, remat, with_data_axis, bn_stats, donate, schedule,
     )

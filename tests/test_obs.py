@@ -330,6 +330,47 @@ def test_report_golden(tmp_path):
         assert needle in out, f"missing {needle!r} in:\n{out}"
 
 
+def test_report_pipeline_line(tmp_path):
+    """The `pipeline:` line: ticks + bubble fraction from the meta config,
+    schedule corroborated by the cost record's tick scopes."""
+    from mpi4dl_tpu.obs.report import render_run
+
+    rl = obs.RunLog.create(str(tmp_path), prefix="pp")
+    rl.write_meta(config={"model": "resnet", "split_size": 2, "parts": 6,
+                          "schedule": "1f1b"},
+                  mesh_spec={"stage": 2}, family="lp")
+    rl.write("cost", flops=1e9, bytes_accessed=1e8,
+             tick_scopes=["bwd_tick", "fwd_tick", "pp_1f1b_scan"],
+             peak_flops=1e12, peak_source="table", device_count=2)
+    rl.write_step(epoch=0, step=0, ms=10.0, images_per_sec=1.0,
+                  loss=1.0, accuracy=0.5)
+    rl.close()
+    out = render_run(rl.path)
+    # 1F1B: ticks = parts + 2(S-1) = 8; bubble = 2(S-1)/8 = 0.25.
+    assert ("pipeline: schedule=1f1b  stages=2  parts=6  ticks/step=8  "
+            "bubble=0.250") in out
+    assert "scopes: bwd_tick,fwd_tick,pp_1f1b_scan" in out
+
+    rl2 = obs.RunLog.create(str(tmp_path), prefix="pp-g")
+    rl2.write_meta(config={"model": "resnet", "split_size": 4, "parts": 8},
+                   mesh_spec={"stage": 4}, family="lp")
+    rl2.close()
+    out2 = render_run(rl2.path)
+    # GPipe default: ticks = parts + S - 1 = 11; bubble = 3/11.
+    assert ("pipeline: schedule=gpipe  stages=4  parts=8  ticks/step=11  "
+            "bubble=0.273") in out2
+
+    # family="single" must NOT render a pipeline line even when the config
+    # carries pipeline-flag defaults (mem_probe's single-chip mode records
+    # raw argparse vars, --split-size included).
+    rl3 = obs.RunLog.create(str(tmp_path), prefix="pp-s")
+    rl3.write_meta(config={"model": "resnet", "split_size": 2, "parts": 4,
+                           "schedule": "both"},
+                   mesh_spec={}, family="single")
+    rl3.close()
+    assert "pipeline:" not in render_run(rl3.path)
+
+
 def test_report_cli_main(tmp_path, capsys):
     from mpi4dl_tpu.obs.__main__ import main
 
