@@ -25,6 +25,34 @@ judged by (docs/pipeline.md):
 ``--telemetry-dir`` mirrors the table into a RunLog JSONL as a ``mem_probe``
 record (rendered by ``python -m mpi4dl_tpu.obs report``); ``--require-1f1b-win``
 exits 1 unless the 1f1b row's peak is strictly below gpipe's — the CI gate.
+
+``--attribute`` adds the per-``obs.scope`` HBM breakdown (obs/hbm.py: which
+scope owns the peak bytes, coverage metric, top buffers) and the analytical
+timeline (obs/timeline.py) to every probed row — the microscope over the
+aggregate number.  Gates: ``--min-coverage 0.9`` fails the run when less
+than 90% of peak bytes attribute to named scopes; ``--require-attrib-top
+sp_region,junction`` fails unless one of the named phase groups owns the
+plurality of scoped peak bytes (the PR-5 "the memory lives in the spatial
+phase + junction" finding, machine-checked in CI).
+
+``--delta-parts N`` (family mode) probes the SAME config a second time at
+``parts=N`` (micro-batch size held fixed, so the batch scales with parts)
+and emits the per-scope growth between the two — the "+19.5 GB/device per
+part" PR-5 finding as a first-class artifact: *which scope grows when parts
+grow*.  ``--require-delta-top sp_region,junction`` exits 1 unless the
+phase group with the largest positive growth matches one of the prefixes
+(the CI gate: the O(parts) memory lives in the spatial phase + junction,
+not the tail).
+
+``--sweep-junction`` sweeps the SP->LP junction placement (``spatial_until``)
+for the sp family and emits the placement frontier — per-placement compiled
+peak HBM plus the analytic spatial-activation ledger — as a BENCH-style JSON
+artifact and a ``junction_sweep`` RunLog record (rendered by ``obs report``;
+ROADMAP item 1's 370-vs-116.7 GB/device placement finding as a reproducible
+artifact):
+
+    python benchmarks/mem_probe.py --sweep-junction --image-size 64 \
+        --num-layers 11 --split-size 2 --parts 2 --batch 4
 """
 
 from __future__ import annotations
@@ -55,6 +83,25 @@ def _mem_row(compiled, compile_s: float) -> dict:
     return row
 
 
+def _attribution(compiled, args, schedule=None) -> dict:
+    """The per-scope breakdown + analytical timeline of one compiled row
+    (``--attribute``); printed to stderr, embedded in the JSON artifact."""
+    import jax
+
+    from mpi4dl_tpu.obs import analytical_timeline, attribute_compiled
+    from mpi4dl_tpu.obs.hbm import format_breakdown
+
+    hlo_text = compiled.as_text()
+    breakdown = attribute_compiled(compiled, hlo_text=hlo_text)
+    timeline = analytical_timeline(
+        hlo_text, device=jax.devices()[0],
+        schedule=schedule, stages=getattr(args, "split_size", None),
+        parts=getattr(args, "parts", None),
+    )
+    print(format_breakdown(breakdown), file=sys.stderr)
+    return {"hbm": breakdown, "timeline": timeline}
+
+
 def _probe_single(args) -> dict:
     from bench import build_probe_setup
 
@@ -64,10 +111,13 @@ def _probe_single(args) -> dict:
     )
     t0 = time.perf_counter()
     compiled = step.lower(state, x, y).compile()
-    return {
+    out = {
         "config": vars(args),
         **_mem_row(compiled, time.perf_counter() - t0),
     }
+    if args.attribute:
+        out.update(_attribution(compiled, args))
+    return out
 
 
 def _probe_family(args) -> dict:
@@ -121,6 +171,8 @@ def _probe_family(args) -> dict:
         t0 = time.perf_counter()
         compiled = step.lower(state, x, y).compile()
         rows[schedule] = _mem_row(compiled, time.perf_counter() - t0)
+        if args.attribute:
+            rows[schedule].update(_attribution(compiled, args, schedule))
         print(
             f"[mem_probe] {args.family}/{schedule}: "
             f"{rows[schedule]['peak_gb_est']} GB peak "
@@ -144,6 +196,236 @@ def _probe_family(args) -> dict:
     return out
 
 
+def _sweep_junction(args) -> dict:
+    """Junction-placement frontier: compile the sp engine at each candidate
+    ``spatial_until`` and record peak HBM per placement (ROADMAP item 1's
+    placement search — naive placement measured 370 vs 116.7 GB/device at
+    8K; this makes the frontier a reproducible artifact at any size)."""
+    import jax
+    import jax.numpy as jnp
+
+    from benchmarks.common import _ensure_devices
+    from mpi4dl_tpu.layer_ctx import SpatialCtx
+    from mpi4dl_tpu.mesh import AXIS_SPW, MeshSpec, build_mesh
+    from mpi4dl_tpu.models.amoebanet import amoebanetd
+    from mpi4dl_tpu.models.resnet import get_resnet_v2
+    from mpi4dl_tpu.parallel.sp_pipeline import (
+        SPPipeline, init_sp_pipeline_state, make_sp_pipeline_train_step,
+    )
+    from mpi4dl_tpu.train import Optimizer
+
+    S, g, px = args.split_size, args.num_spatial_parts, args.image_size
+    micro = args.batch // args.parts
+    assert micro >= 1, "batch must cover parts"
+    # Before any jax op (model.init below) initializes the backend.
+    _ensure_devices(S * g)
+    shape = (micro, px, px, 3)
+    if args.arch == "resnet":
+        model = get_resnet_v2(shape, depth=args.num_layers,
+                              num_classes=args.num_classes)
+    else:
+        model = amoebanetd(shape, num_classes=args.num_classes,
+                           num_layers=args.num_layers,
+                           num_filters=args.num_filters)
+    params, shapes = model.init(jax.random.key(0))
+    n_cells = len(model.cells)
+
+    if args.junction_levels:
+        levels = [int(s) for s in args.junction_levels.split(",")]
+    else:
+        # Every legal placement: at least one spatial cell, at least one
+        # tail cell (the head can never run tiled).
+        levels = list(range(1, n_cells - 1))
+    mesh = build_mesh(MeshSpec(stage=S, spw=g), jax.devices()[:S * g])
+    sp = SpatialCtx(axis_w=AXIS_SPW, grid_w=g)
+    opt = Optimizer("sgd", lr=0.01)
+    x = jnp.zeros((args.parts * micro, px, px, 3), jnp.float32)
+    y = jnp.zeros((args.parts * micro,), jnp.int32)
+
+    placements = []
+    for su in levels:
+        model.spatial_until = su
+        # Analytic spatial-activation ledger (eval_shape bytes, tiled by
+        # the grid) — monotone in placement by construction; the compiled
+        # peak is the measured counterpart.
+        spatial_mb = 0.0
+        for i, shp in enumerate(shapes[:su]):
+            shps = shp if isinstance(shp[0], tuple) else (shp,)
+            for s in shps:
+                n = 1
+                for d in s:
+                    n *= d
+                spatial_mb += n * 4 / g / 2**20
+        spp = SPPipeline.build(model, params, S, sp, microbatch=micro,
+                               junction="gather")
+        step = make_sp_pipeline_train_step(
+            spp, opt, mesh, parts=args.parts,
+            remat=args.remat != "none", schedule=(
+                args.schedule if args.schedule != "both" else "gpipe"
+            ),
+        )
+        state = init_sp_pipeline_state(spp, params, opt, mesh)
+        t0 = time.perf_counter()
+        compiled = step.lower(state, x, y).compile()
+        row = _mem_row(compiled, time.perf_counter() - t0)
+        entry = {
+            "spatial_until": su,
+            "spatial_cells": su,
+            "tail_cells": n_cells - su,
+            "spatial_ledger_mb": round(spatial_mb, 2),
+            **row,
+        }
+        if args.attribute:
+            entry.update(_attribution(compiled, args))
+        placements.append(entry)
+        print(
+            f"[mem_probe] sweep spatial_until={su}: "
+            f"{row['peak_gb_est']} GB peak ({row['compile_s']}s compile)",
+            file=sys.stderr,
+        )
+    best = min(placements, key=lambda p: p["peak_gb_est"])
+    for p in placements:
+        p["best"] = p is best
+    # "Naive" = the deepest spatial region probed (ROADMAP item 1's config
+    # A), regardless of the order --junction-levels listed the candidates.
+    naive = max(placements, key=lambda p: p["spatial_until"])
+    return {
+        "metric": "junction_frontier_peak_gb",
+        "value": best["peak_gb_est"],
+        "unit": "GB/device",
+        "family": "sp",
+        "mesh": str(MeshSpec(stage=S, spw=g)),
+        "config": {**vars(args), "remat": args.remat != "none"},
+        "placements": placements,
+        "best": {k: best[k] for k in ("spatial_until", "peak_gb_est")},
+        "naive": {k: naive[k] for k in ("spatial_until", "peak_gb_est")},
+        "naive_over_best": (
+            round(naive["peak_gb_est"] / best["peak_gb_est"], 3)
+            if best["peak_gb_est"] else None
+        ),
+    }
+
+
+def growth_groups(bd_a: dict, bd_b: dict, parts_a: int, parts_b: int) -> dict:
+    """Per-phase-group byte growth between two breakdowns of the same config
+    at different part counts, normalized per part: ``{group: bytes/part}``
+    sorted by growth.  Pure (unit-tested in tests/test_hbm.py)."""
+    from mpi4dl_tpu.obs.hbm import scope_group_bytes
+
+    ga, gb = scope_group_bytes(bd_a), scope_group_bytes(bd_b)
+    dparts = parts_b - parts_a
+    if dparts <= 0:
+        raise ValueError(f"need parts_b > parts_a, got {parts_a}->{parts_b}")
+    out = {
+        k: (gb.get(k, 0) - ga.get(k, 0)) / dparts
+        for k in set(ga) | set(gb)
+        if gb.get(k, 0) != ga.get(k, 0)
+    }
+    return dict(sorted(out.items(), key=lambda kv: -kv[1]))
+
+
+def top_growth_group(growth: dict) -> "str | None":
+    """The phase group with the largest positive per-part growth (arguments
+    and unattributed excluded — the question is which *phase* owns the
+    O(parts) bytes)."""
+    from mpi4dl_tpu.obs.hbm import ARGS_SCOPE, UNATTRIBUTED
+
+    for k, v in growth.items():  # sorted descending
+        if k == UNATTRIBUTED or k.startswith(ARGS_SCOPE):
+            continue
+        return k if v > 0 else None
+    return None
+
+
+def _parts_delta(args, out) -> dict:
+    """Probe the family again at ``--delta-parts`` (same micro-batch size)
+    and attach the per-scope growth ledger to the artifact."""
+    import argparse as _ap
+
+    from mpi4dl_tpu.obs.hbm import compare_breakdowns
+
+    micro = max(args.batch // args.parts, 1)
+    args_b = _ap.Namespace(**{
+        **vars(args),
+        "parts": args.delta_parts,
+        "batch": micro * args.delta_parts,
+        "delta_parts": None,
+        "telemetry_dir": None,
+    })
+    out_b = _probe_family(args_b)
+    delta = {
+        "parts_a": args.parts, "parts_b": args.delta_parts,
+        "micro_batch": micro, "per_schedule": {},
+    }
+    for sched, row in out["schedules"].items():
+        row_b = (out_b["schedules"] or {}).get(sched)
+        if not (row.get("hbm") and row_b and row_b.get("hbm")):
+            continue
+        growth = growth_groups(
+            row["hbm"], row_b["hbm"], args.parts, args.delta_parts
+        )
+        delta["per_schedule"][sched] = {
+            "growth_bytes_per_part": growth,
+            "top_growth_group": top_growth_group(growth),
+            "peak_delta_bytes": compare_breakdowns(
+                row["hbm"], row_b["hbm"]
+            )["peak_delta_bytes"],
+        }
+        print(
+            f"[mem_probe] {args.family}/{sched} growth "
+            f"parts {args.parts}->{args.delta_parts} (bytes/part):",
+            file=sys.stderr,
+        )
+        for k, v in list(growth.items())[:8]:
+            print(f"  {v / 2**20:>10.1f} MB/part  {k}", file=sys.stderr)
+    return delta
+
+
+def _check_gates(args, rows) -> int:
+    """--min-coverage / --require-attrib-top over every attributed row;
+    returns the number of gate failures (each reported on stderr)."""
+    from mpi4dl_tpu.obs.hbm import scope_group_bytes, ARGS_SCOPE, UNATTRIBUTED
+
+    failures = 0
+    for label, row in rows:
+        bd = row.get("hbm")
+        if bd is None:
+            continue
+        if args.min_coverage is not None and bd["coverage"] < args.min_coverage:
+            print(
+                f"[mem_probe] FAIL {label}: coverage {bd['coverage']:.3f} "
+                f"< --min-coverage {args.min_coverage}",
+                file=sys.stderr,
+            )
+            failures += 1
+        if args.require_attrib_top:
+            prefixes = tuple(
+                s.strip() for s in args.require_attrib_top.split(",") if s.strip()
+            )
+            groups = scope_group_bytes(bd)
+            phase = next(
+                (k for k in groups
+                 if k != UNATTRIBUTED and not k.startswith(ARGS_SCOPE)),
+                None,
+            )
+            if phase is None or not any(phase.startswith(p) for p in prefixes):
+                print(
+                    f"[mem_probe] FAIL {label}: plurality scope group "
+                    f"{phase!r} does not match --require-attrib-top "
+                    f"{prefixes} (groups: "
+                    f"{ {k: v for k, v in list(groups.items())[:4]} })",
+                    file=sys.stderr,
+                )
+                failures += 1
+            else:
+                print(
+                    f"[mem_probe] OK {label}: plurality scope group {phase!r}"
+                    f" owns {groups[phase]} bytes at peak",
+                    file=sys.stderr,
+                )
+    return failures
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--image-size", type=int, default=2048)
@@ -155,10 +437,11 @@ def main(argv=None) -> int:
     p.add_argument("--arch", default="resnet", choices=["amoeba", "resnet"])
     p.add_argument("--scan", type=int, default=1)
     p.add_argument("--family", default="single",
-                   choices=["single", "lp", "gems", "sp", "gems_sp"],
+                   choices=["single", "lp", "gems", "sp", "sp_pipeline",
+                            "gems_sp"],
                    help="'single' probes a one-chip rung (bench.py path); "
                         "the engine families probe the PP train step on a "
-                        "virtual mesh")
+                        "virtual mesh ('sp_pipeline' is an alias for 'sp')")
     p.add_argument("--schedule", default="both",
                    choices=["gpipe", "1f1b", "both"],
                    help="pipeline schedule(s) to probe (family mode)")
@@ -174,17 +457,83 @@ def main(argv=None) -> int:
     p.add_argument("--require-1f1b-win", action="store_true",
                    help="exit 1 unless 1f1b peak < gpipe peak (needs "
                         "--schedule both)")
+    p.add_argument("--attribute", action="store_true",
+                   help="add the per-obs.scope HBM breakdown + analytical "
+                        "timeline to every probed row (obs/hbm.py, "
+                        "obs/timeline.py; docs/observability.md)")
+    p.add_argument("--min-coverage", type=float, default=None,
+                   help="with --attribute: exit 1 when less than this "
+                        "fraction of peak bytes attributes to named scopes")
+    p.add_argument("--require-attrib-top", default=None,
+                   help="with --attribute: exit 1 unless the plurality "
+                        "scope group at peak starts with one of these "
+                        "comma-separated prefixes (e.g. 'sp_region,junction')")
+    p.add_argument("--delta-parts", type=int, default=None,
+                   help="with --attribute in family mode: probe the same "
+                        "config again at this part count (micro-batch held "
+                        "fixed) and emit the per-scope O(parts) growth "
+                        "ledger — the PR-5 '+GB/device per part' finding "
+                        "as an artifact")
+    p.add_argument("--require-delta-top", default=None,
+                   help="with --delta-parts: exit 1 unless the phase group "
+                        "with the largest positive per-part growth starts "
+                        "with one of these comma-separated prefixes "
+                        "(e.g. 'sp_region,junction,stage_lineup')")
+    p.add_argument("--sweep-junction", action="store_true",
+                   help="sweep the SP->LP junction placement (spatial_until)"
+                        " and emit the placement frontier artifact")
+    p.add_argument("--junction-levels", default=None,
+                   help="comma-separated spatial_until candidates for "
+                        "--sweep-junction (default: every legal placement)")
     p.add_argument("--out", default=None, help="also write the JSON here")
     args = p.parse_args(argv)
+    if args.family == "sp_pipeline":
+        args.family = "sp"
+    if args.delta_parts is not None and (
+        args.sweep_junction or args.family == "single"
+    ):
+        print("[mem_probe] --delta-parts needs an engine family "
+              "(--family lp|gems|sp|gems_sp, no --sweep-junction)",
+              file=sys.stderr)
+        return 2
+    # Attribution gates without --attribute would silently check nothing;
+    # fail at parse time, before any compile is paid for.
+    if not args.attribute and (
+        args.min_coverage is not None or args.require_attrib_top
+        or args.delta_parts is not None or args.require_delta_top
+    ):
+        print("[mem_probe] --min-coverage/--require-attrib-top/"
+              "--delta-parts/--require-delta-top need --attribute",
+              file=sys.stderr)
+        return 2
 
     import jax
 
-    print(f"[mem_probe] device={jax.devices()[0] if args.family == 'single' else 'virtual mesh'}",
+    if args.attribute:
+        # The persistent compilation cache keys on the program MINUS debug
+        # metadata; a scope-less executable compiled elsewhere (e.g. an
+        # MPI4DL_NO_SCOPES A/B run) would alias this build and return HLO
+        # text without op_name paths — attribution requires a fresh compile.
+        jax.config.update("jax_compilation_cache_dir", None)
+
+    # Careful not to touch jax.devices() before a mesh mode self-provisions
+    # the virtual CPU platform (backend init is one-shot).
+    single = args.family == "single" and not args.sweep_junction
+    print(f"[mem_probe] device={jax.devices()[0] if single else 'virtual mesh'}",
           file=sys.stderr)
-    if args.family == "single":
+    if args.sweep_junction:
+        out = _sweep_junction(args)
+        gate_rows = [(f"su={p_['spatial_until']}", p_)
+                     for p_ in out["placements"]]
+    elif args.family == "single":
         out = _probe_single(args)
+        gate_rows = [("single", out)]
     else:
         out = _probe_family(args)
+        gate_rows = [(f"{args.family}/{s}", r)
+                     for s, r in out["schedules"].items()]
+        if args.delta_parts is not None:
+            out["parts_delta"] = _parts_delta(args, out)
 
     line = json.dumps(out)
     print(line)
@@ -198,10 +547,53 @@ def main(argv=None) -> int:
         runlog.write_meta(config=out.get("config") or vars(args),
                           family=args.family,
                           argv=list(argv) if argv is not None else sys.argv[1:])
-        runlog.write("mem_probe", **out)
+        if args.sweep_junction:
+            runlog.write("junction_sweep", placements=out["placements"],
+                         best=out["best"], naive=out["naive"],
+                         naive_over_best=out["naive_over_best"])
+        else:
+            runlog.write("mem_probe", **out)
+        for label, row in gate_rows:
+            if row.get("hbm") is not None:
+                runlog.write("hbm", label=label, breakdown=row["hbm"])
+            if row.get("timeline") is not None:
+                runlog.write("timeline", label=label, **row["timeline"])
         runlog.close()
         print(f"[mem_probe] telemetry written to {runlog.path}",
               file=sys.stderr)
+    if args.attribute and (args.min_coverage is not None
+                           or args.require_attrib_top):
+        if _check_gates(args, gate_rows):
+            return 1
+    if args.require_delta_top:
+        prefixes = tuple(s.strip() for s in args.require_delta_top.split(",")
+                         if s.strip())
+        fails = 0
+        for sched, d in (out.get("parts_delta") or {}).get(
+            "per_schedule", {}
+        ).items():
+            topg = d.get("top_growth_group")
+            if topg is None or not any(topg.startswith(p_) for p_ in prefixes):
+                print(
+                    f"[mem_probe] FAIL {args.family}/{sched}: top O(parts) "
+                    f"growth group {topg!r} does not match "
+                    f"--require-delta-top {prefixes}",
+                    file=sys.stderr,
+                )
+                fails += 1
+            else:
+                gbp = d["growth_bytes_per_part"][topg] / 2**30
+                print(
+                    f"[mem_probe] OK {args.family}/{sched}: O(parts) memory "
+                    f"lives in {topg!r} ({gbp:.3f} GB/device/part)",
+                    file=sys.stderr,
+                )
+        if fails or not (out.get("parts_delta") or {}).get("per_schedule"):
+            if not fails:
+                print("[mem_probe] FAIL: --require-delta-top with no "
+                      "parts-delta rows (need --delta-parts + --attribute "
+                      "in family mode)", file=sys.stderr)
+            return 1
     if args.require_1f1b_win:
         win = out.get("win_1f1b_gb")
         if win is None or win <= 0:

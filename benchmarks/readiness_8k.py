@@ -53,6 +53,15 @@ def main(argv=None) -> int:
     p.add_argument("--spatial-until", type=int, default=9,
                    help="cells in the spatial region (stems + first normal "
                         "group by default — the high-resolution cells)")
+    p.add_argument("--attribute", action="store_true",
+                   help="add the per-obs.scope HBM breakdown + analytical "
+                        "timeline (obs/hbm.py, obs/timeline.py) to the "
+                        "artifact — names which phase owns the per-device "
+                        "GB this tool reports")
+    p.add_argument("--telemetry-dir", default=None,
+                   help="mirror the artifact into a RunLog JSONL "
+                        "(readiness + hbm + timeline records; render with "
+                        "`python -m mpi4dl_tpu.obs report`)")
     p.add_argument("--out", default=None)
     args = p.parse_args(argv)
 
@@ -149,7 +158,10 @@ def main(argv=None) -> int:
         + ma.output_size_in_bytes
         - ma.alias_size_in_bytes
     ) / 2**30
-    comm = hlo_collective_stats(compiled.as_text())
+    # Serialize the module once — as_text() on the 8K flagship program is
+    # the dominant non-compile cost; the attribution block reuses it.
+    hlo_text = compiled.as_text()
+    comm = hlo_collective_stats(hlo_text)
 
     out = {
         "metric": "readiness_8k_per_device_gb",
@@ -179,11 +191,43 @@ def main(argv=None) -> int:
             ),
         },
     }
+    breakdown = timeline = None
+    if args.attribute:
+        from mpi4dl_tpu.obs import analytical_timeline, attribute_compiled
+        from mpi4dl_tpu.obs.hbm import format_breakdown, scope_group_bytes
+
+        breakdown = attribute_compiled(compiled, hlo_text=hlo_text)
+        timeline = analytical_timeline(
+            hlo_text, device=jax.devices()[0],
+            schedule=args.schedule, stages=S, parts=args.parts,
+        )
+        out["hbm"] = breakdown
+        out["timeline"] = timeline
+        out["hbm_phase_groups_gb"] = {
+            k: round(v / 2**30, 3)
+            for k, v in scope_group_bytes(breakdown).items()
+        }
+        print(format_breakdown(breakdown), file=sys.stderr)
+
     line = json.dumps(out)
     print(line)
     if args.out:
         with open(args.out, "w") as f:
             f.write(line)
+    if args.telemetry_dir:
+        from mpi4dl_tpu.obs import RunLog
+
+        runlog = RunLog.create(args.telemetry_dir, prefix="readiness")
+        runlog.write_meta(config=out["config"], family="sp",
+                          argv=list(argv) if argv is not None else sys.argv[1:])
+        runlog.write("readiness", **{k: v for k, v in out.items()
+                                     if k not in ("hbm", "timeline")})
+        if breakdown is not None:
+            runlog.write("hbm", label="readiness", breakdown=breakdown)
+            runlog.write("timeline", label="readiness", **timeline)
+        runlog.close()
+        print(f"[readiness] telemetry written to {runlog.path}",
+              file=sys.stderr)
     return 0
 
 

@@ -11,6 +11,7 @@ from mpi4dl_tpu.analysis.rules_dtype import RULE as _dtype
 from mpi4dl_tpu.analysis.rules_env import RULE as _env
 from mpi4dl_tpu.analysis.rules_print import RULE as _print
 from mpi4dl_tpu.analysis.rules_retrace import RULE as _retrace
+from mpi4dl_tpu.analysis.rules_scope import RULE as _scope
 from mpi4dl_tpu.analysis.rules_swallow import RULE as _swallow
 from mpi4dl_tpu.analysis.rules_thread import RULE as _thread
 from mpi4dl_tpu.analysis.rules_tracer import RULE as _tracer
@@ -24,6 +25,7 @@ RULE_TABLE: List[Rule] = [
     _print,
     _swallow,
     _thread,
+    _scope,
 ]
 
 RULES_BY_NAME: Dict[str, Rule] = {r.name: r for r in RULE_TABLE}

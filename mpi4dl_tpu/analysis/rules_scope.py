@@ -1,0 +1,99 @@
+"""Rule ``unscoped-collective`` (rule 10): collectives in the communication
+layers must run under an ``obs.scope``.
+
+The per-scope observability stack (obs/hbm.py HBM attribution, obs/timeline
+collective-time estimates, the contract gate's per-scope collective ledger)
+only works while every collective lowers inside a named scope — an
+``lax.ppermute`` added without one lands in the ``(unattributed)`` bucket
+and silently decays the coverage metric the CI gate asserts.  This rule
+makes that decay a build failure at the source level, before any artifact
+is extracted.
+
+Scope: files under ``mpi4dl_tpu/parallel/`` and ``mpi4dl_tpu/ops/`` (the
+communication layers; engines and kernels).  A collective call site must be
+lexically inside a ``with obs.scope(...)``/``scope(...)``/
+``jax.named_scope(...)`` block.  Helpers whose *callers* own the scope carry
+the standard ``# analysis: ok(unscoped-collective)`` pragma with a comment
+saying which scope covers them.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Tuple
+
+from mpi4dl_tpu.analysis.core import Project, Rule, Violation
+
+# jax.lax collective callables (data-moving or reducing across mesh axes).
+_COLLECTIVES = (
+    "ppermute", "psum", "pmean", "pmax", "pmin", "all_gather",
+    "psum_scatter", "all_to_all", "pbroadcast",
+)
+
+# Context-manager callees that establish a named scope.
+_SCOPE_CALLEES = (
+    "mpi4dl_tpu.obs.scopes.scope", "mpi4dl_tpu.obs.scope", "obs.scope",
+    "jax.named_scope",
+)
+
+
+def _is_target(rel: str) -> bool:
+    rel = f"/{rel}"
+    return "mpi4dl_tpu/parallel/" in rel or "mpi4dl_tpu/ops/" in rel
+
+
+class UnscopedCollectiveRule(Rule):
+    name = "unscoped-collective"
+    description = (
+        "collective issued in mpi4dl_tpu/parallel|ops without an enclosing "
+        "obs.scope — per-scope HBM/collective attribution would lose it; "
+        "wrap it in `with scope(...)` or pragma a caller-scoped helper."
+    )
+
+    def check(self, project: Project) -> List[Violation]:
+        out: List[Violation] = []
+        for src in project.files:
+            if not _is_target(src.rel):
+                continue
+            scoped_spans: List[Tuple[int, int]] = []
+            for w in src.nodes(ast.With):
+                for item in w.items:
+                    ctx = item.context_expr
+                    if not isinstance(ctx, ast.Call):
+                        continue
+                    resolved = src.resolve(ctx.func) or ""
+                    if resolved in _SCOPE_CALLEES or resolved.endswith(
+                        ".named_scope"
+                    ):
+                        scoped_spans.append(
+                            (w.lineno, getattr(w, "end_lineno", w.lineno))
+                        )
+                        break
+            for node in src.nodes(ast.Call):
+                resolved = src.resolve(node.func) or ""
+                parts = resolved.split(".")
+                if parts[-1] not in _COLLECTIVES:
+                    continue
+                # Only the jax.lax spellings (a local helper named `psum`
+                # resolves to the bare name and is its own call site).
+                if not (resolved.startswith("jax.lax.")
+                        or resolved.startswith("lax.")):
+                    continue
+                if any(a <= node.lineno <= b for a, b in scoped_spans):
+                    continue
+                out.append(
+                    Violation(
+                        self.name,
+                        src.rel,
+                        node.lineno,
+                        f"{parts[-1]} with no enclosing obs.scope — wrap in "
+                        "`with scope(name):` so HBM/collective attribution "
+                        "keeps its owner (docs/observability.md); helpers "
+                        "covered by a caller's scope take "
+                        "`# analysis: ok(unscoped-collective)`",
+                    )
+                )
+        return out
+
+
+RULE = UnscopedCollectiveRule()

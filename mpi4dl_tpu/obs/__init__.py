@@ -35,9 +35,26 @@ from mpi4dl_tpu.obs.runlog import (
 from mpi4dl_tpu.obs.costs import (
     arithmetic_intensity,
     compiled_cost,
+    ici_bytes_per_s,
     mfu,
     peak_flops,
     step_cost,
+)
+from mpi4dl_tpu.obs.hbm import (
+    attribute_compiled,
+    attribute_hlo,
+    compare_breakdowns,
+    format_breakdown,
+    format_delta,
+    scope_group_bytes,
+    top_scope,
+)
+from mpi4dl_tpu.obs.timeline import (
+    analytical_timeline,
+    bubble_fraction,
+    format_timeline,
+    hlo_scope_costs,
+    pipeline_ticks,
 )
 from mpi4dl_tpu.obs.hlo_stats import (
     clean_scope_path,
@@ -53,19 +70,31 @@ from mpi4dl_tpu.obs.hlo_stats import (
 __all__ = [
     "RunLog",
     "active_hatches",
+    "analytical_timeline",
     "arithmetic_intensity",
+    "attribute_compiled",
+    "attribute_hlo",
+    "bubble_fraction",
     "clean_scope_path",
+    "compare_breakdowns",
     "compiled_collective_stats",
     "compiled_cost",
     "device_memory_watermark",
+    "format_breakdown",
+    "format_delta",
+    "format_timeline",
     "hlo_collective_stats",
+    "hlo_scope_costs",
     "host_rss_peak_bytes",
+    "ici_bytes_per_s",
     "jit_cache_size",
     "mfu",
     "peak_flops",
+    "pipeline_ticks",
     "read_runlog",
     "scope",
     "scope_coverage",
+    "scope_group_bytes",
     "scope_names",
     "scopes_enabled",
     "stablehlo_collectives",
@@ -73,4 +102,5 @@ __all__ = [
     "stablehlo_sharding_annotations",
     "step_annotation",
     "step_cost",
+    "top_scope",
 ]

@@ -1,8 +1,10 @@
-"""CLI: ``python -m mpi4dl_tpu.obs report run.jsonl [more.jsonl ...]``.
+"""CLI: ``python -m mpi4dl_tpu.obs report run.jsonl [more.jsonl ...]``
+and ``... report --compare A.jsonl B.jsonl [--threshold PCT]``.
 
-Renders the summary table of one or more RunLog files (docs/observability.md
-documents every field).  Exit status: 0 on success, 2 on usage errors or
-unreadable files.
+Renders the summary table of one or more RunLog files, or the per-metric
+regression diff of two (docs/observability.md documents every field and the
+compare metrics).  Exit status: 0 on success, 1 when --compare finds a
+regression past the threshold, 2 on usage errors or unreadable files.
 """
 
 from __future__ import annotations
@@ -18,11 +20,45 @@ def main(argv: Optional[List[str]] = None) -> int:
         description="Telemetry surfaces (see docs/observability.md).",
     )
     sub = ap.add_subparsers(dest="cmd", required=True)
-    rep = sub.add_parser("report", help="render RunLog JSONL file(s)")
-    rep.add_argument("paths", nargs="+", help="run .jsonl file(s)")
+    rep = sub.add_parser(
+        "report",
+        help="render RunLog JSONL file(s), or A/B-diff two with --compare",
+    )
+    rep.add_argument("paths", nargs="*", help="run .jsonl file(s)")
+    rep.add_argument(
+        "--compare", nargs=2, metavar=("A", "B"), default=None,
+        help="per-metric regression diff (A = baseline, B = candidate): "
+             "step ms, images/sec, peak HBM, collective bytes, mem_probe "
+             "peak; exit 1 when a metric regresses past --threshold",
+    )
+    rep.add_argument(
+        "--threshold", type=float, default=5.0,
+        help="regression threshold in percent for --compare (default 5)",
+    )
     args = ap.parse_args(argv)
 
     if args.cmd == "report":
+        if args.compare and args.paths:
+            print("obs report: --compare takes exactly two files; drop the "
+                  "positional run file(s) or the flag", file=sys.stderr)
+            return 2
+        if args.compare:
+            from mpi4dl_tpu.obs.report import compare_runs
+
+            try:
+                text, breaches = compare_runs(
+                    args.compare[0], args.compare[1], args.threshold
+                )
+            except OSError as e:
+                print(f"obs report: cannot read compare input: {e}",
+                      file=sys.stderr)
+                return 2
+            print(text)
+            return 1 if breaches else 0
+        if not args.paths:
+            print("obs report: need run file(s) or --compare A B",
+                  file=sys.stderr)
+            return 2
         from mpi4dl_tpu.obs.report import render_run
 
         for i, path in enumerate(args.paths):

@@ -37,6 +37,37 @@ PEAK_BF16_FLOPS = [
 # nominal 100 GFLOP/s core, not a measured host capability.
 CPU_NOMINAL_PEAK_FLOPS = 1e11
 
+# Aggregate per-chip ICI bandwidth (public numbers, bytes/s): total
+# inter-chip interconnect bandwidth per chip — the denominator of the
+# analytical collective-time estimates in obs/timeline.py.  Matched like
+# PEAK_BF16_FLOPS (first substring wins, specific v5 spellings first).
+ICI_BYTES_PER_S = [
+    ("v6", 4.48e11),      # 3,584 Gbps
+    ("v5p", 6.0e11),      # 4,800 Gbps
+    ("v5 lite", 2.0e11), ("v5e", 2.0e11), ("v5litepod", 2.0e11),  # 1,600 Gbps
+    ("v5", 6.0e11),
+    ("v4", 3.0e11),       # 2,400 Gbps
+    ("v3", 8.2e10),
+    ("v2", 6.2e10),
+]
+
+# Labeled nominal interconnect for CPU smoke runs — a fixed 10 GB/s
+# reference so analytical timelines are comparable run-over-run on the
+# virtual mesh (NOT a host measurement; same contract as the nominal peak).
+DEFAULT_ICI_BYTES_PER_S = 1e10
+
+
+def ici_bytes_per_s(device) -> Tuple[float, str]:
+    """(aggregate ICI bytes/s, source) for a jax device; source mirrors
+    :func:`peak_flops`: ``"table"``, ``"assumed-max"``, ``"nominal-cpu"``."""
+    kind = (getattr(device, "device_kind", "") or "").lower()
+    if device is None or device.platform == "cpu":
+        return DEFAULT_ICI_BYTES_PER_S, "nominal-cpu"
+    for sub, bw in ICI_BYTES_PER_S:
+        if sub in kind:
+            return bw, "table"
+    return max(b for _, b in ICI_BYTES_PER_S), "assumed-max"
+
 
 def peak_flops(device, allow_cpu_nominal: bool = False
                ) -> Tuple[Optional[float], Optional[str]]:

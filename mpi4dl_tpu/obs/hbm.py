@@ -1,0 +1,616 @@
+"""Per-scope HBM attribution from the compiled HLO module.
+
+``compiled.memory_analysis()`` reports *totals* (temp/argument/output bytes);
+this module answers the question those totals cannot: **which ``obs.scope``
+owns the bytes**.  PR 5 measured that the 8K flagship grows ~19.5 GB/device
+per micro-batch part in the spatial phase + junction — a number read off
+aggregate counters.  Here the compiled module itself is the ledger:
+
+1. The compiled HLO is **scheduled** (``is_scheduled=true``): instruction
+   order per computation is execution order, so classic interval liveness
+   over instruction indices reconstructs the peak live set analytically.
+2. Every instruction carries ``metadata={op_name="jit(step)/.../sp_region/
+   sp_level0/cell03/conv"}`` — the ``obs.scope`` stack — so each live buffer
+   maps to a semantic scope via the same :func:`clean_scope_path` the
+   contract gate uses.
+3. Entry parameters carry their argument names (``state.param_buf``, ``x``),
+   so the argument portion of peak memory is attributed by name too.
+
+The model (documented limits, tested tolerances in tests/test_hbm.py):
+
+- view-like ops (``get-tuple-element``/``bitcast``/``tuple``/``*-done``)
+  allocate nothing and forward liveness to their operands;
+- call-like ops (``while``/``conditional``/``call``/reducers) contribute the
+  callee's own internal peak at the call point, with callee parameters
+  excluded (they alias caller operands) and operands dying into the call
+  subtracted (they alias callee parameters / the while carry);
+- fusion bodies allocate nothing (one output buffer, owned by the caller op).
+
+Against XLA's real buffer assignment this over-estimates (no buffer reuse
+across disjoint-lifetime same-shape values, while carries double-buffered at
+the boundary) — but the *attribution shares* are what the memory campaigns
+need, and the absolute estimate reconciles with ``memory_analysis()`` within
+the tested tolerance on the engine families.
+
+Surfaces: ``benchmarks/mem_probe.py --attribute`` (per-rung breakdown +
+coverage gates), the ``hbm`` RunLog record (rendered by ``obs report``), and
+:func:`compare_breakdowns` for A/B config deltas.  obs/timeline.py reuses
+:func:`parse_hlo_module` for its per-scope FLOP/collective estimates.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, List, Optional, Tuple
+
+from mpi4dl_tpu.obs.hlo_stats import clean_scope_path
+
+ARGS_SCOPE = "(args)"
+UNATTRIBUTED = "(unattributed)"
+
+# Ops whose result is a view of an operand (no allocation; liveness forwards
+# to the underlying buffer).  ``*-done`` async halves alias their start tuple.
+_VIEW_OPS = ("get-tuple-element", "bitcast", "tuple", "parameter")
+
+# Call-like ops that execute a non-fusion sub-computation whose internal
+# temps are live while the op runs.
+_CALL_ATTRS = ("body", "condition", "to_apply", "branch_computations",
+               "called_computations")
+
+_SHAPE_TOKEN = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+_OP_NAME = re.compile(r'metadata=\{[^}]*op_name="([^"]*)"')
+_COMP_REF = re.compile(
+    r"(?:body|condition|to_apply|calls)=(%[\w.\-]+)"
+    r"|(?:branch_computations|called_computations)=\{([^}]*)\}"
+)
+_DTYPE_BYTES = {
+    "f32": 4, "bf16": 2, "f16": 2, "f64": 8, "pred": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "s32": 4, "u32": 4, "s64": 8, "u64": 8,
+    "c64": 8, "c128": 16, "f8e4m3fn": 1, "f8e5m2": 1,
+}
+
+
+def shape_bytes(shape_str: str) -> int:
+    """Total payload bytes of an HLO shape literal (tuples sum members):
+    ``'(f32[65536]{0}, bf16[2,8,8,4])'`` -> 262144 + 1024."""
+    total = 0
+    for dt, dims in _SHAPE_TOKEN.findall(shape_str):
+        if dt == "token":
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES.get(dt, 4)
+    return total
+
+
+@dataclasses.dataclass
+class Instr:
+    """One parsed HLO instruction (scheduled position = list index)."""
+    name: str
+    shape: str
+    opcode: str
+    bytes: int
+    operands: Tuple[str, ...]
+    callees: Tuple[str, ...]
+    op_name: str  # raw metadata op_name ("" when absent)
+    scope: str    # clean_scope_path(op_name)
+
+    @property
+    def is_view(self) -> bool:
+        return self.opcode in _VIEW_OPS or self.opcode.endswith("-done")
+
+
+def _balanced(text: str, start: int) -> int:
+    """Index one past the ')' matching the '(' at ``start``."""
+    depth = 0
+    for i in range(start, len(text)):
+        if text[i] == "(":
+            depth += 1
+        elif text[i] == ")":
+            depth -= 1
+            if depth == 0:
+                return i + 1
+    return len(text)
+
+
+_INSTR_HEAD = re.compile(r"^\s*(?:ROOT\s+)?(%[\w.\-]+)\s*=\s*")
+
+
+def _parse_instruction(line: str) -> Optional[Instr]:
+    m = _INSTR_HEAD.match(line)
+    if m is None:
+        return None
+    name = m.group(1)
+    rest = line[m.end():]
+    # Defined shape: a parenthesized tuple or one token (layout included).
+    if rest.startswith("("):
+        end = _balanced(rest, 0)
+    else:
+        end = rest.find(" ")
+        if end < 0:
+            return None
+    shape = rest[:end]
+    rest = rest[end:].lstrip()
+    om = re.match(r"([\w\-]+)\(", rest)
+    if om is None:
+        return None
+    opcode = om.group(1)
+    op_end = _balanced(rest, om.end() - 1)
+    operand_str = rest[om.end():op_end - 1]
+    attrs = rest[op_end:]
+
+    callees: List[str] = []
+    for single, multi in _COMP_REF.findall(attrs):
+        if single:
+            callees.append(single)
+        else:
+            callees.extend(t.strip() for t in multi.split(",") if t.strip())
+    operands = tuple(re.findall(r"(%[\w.\-]+)", operand_str))
+    op_name = ""
+    mm = _OP_NAME.search(attrs) or _OP_NAME.search(line)
+    if mm:
+        op_name = mm.group(1)
+    return Instr(
+        name=name, shape=shape, opcode=opcode, bytes=shape_bytes(shape),
+        operands=operands, callees=tuple(callees), op_name=op_name,
+        scope=clean_scope_path(op_name) if "/" in op_name else "",
+    )
+
+
+def parse_hlo_module(hlo_text: str) -> Tuple[Dict[str, List[Instr]], str]:
+    """``(computations, entry_name)`` for a compiled HLO module's text.
+    Computation keys keep their ``%`` sigil; instruction order is the
+    module's schedule order (``is_scheduled=true``)."""
+    comps: Dict[str, List[Instr]] = {}
+    entry = ""
+    cur: Optional[List[Instr]] = None
+    for line in hlo_text.splitlines():
+        if not line:
+            continue
+        if not line.startswith(" "):
+            m = re.match(r"(ENTRY\s+)?(%[\w.\-]+)\s*\(.*\{\s*$", line)
+            if m:
+                cur = comps.setdefault(m.group(2), [])
+                if m.group(1):
+                    entry = m.group(2)
+                continue
+            if line.startswith("}"):
+                cur = None
+            continue
+        if cur is None:
+            continue
+        ins = _parse_instruction(line)
+        if ins is not None:
+            cur.append(ins)
+    return comps, entry
+
+
+# ---------------------------------------------------------------------------
+# Liveness simulation
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class LiveBuffer:
+    name: str
+    bytes: int
+    shape: str
+    scope: str
+    category: str  # "temp" | "argument" | "constant"
+    op_name: str
+
+
+class _ModulePeak:
+    """Per-computation analytical peak with memoization over the call graph."""
+
+    def __init__(self, comps: Dict[str, List[Instr]]):
+        self.comps = comps
+        self._cache: Dict[str, Tuple[int, List[LiveBuffer]]] = {}
+        self._scope_cache: Dict[str, str] = {}
+
+    def scope_of(self, ins: Instr) -> str:
+        """The instruction's scope; ``while``/``conditional`` ops lowered
+        without their own op_name metadata (jax emits none for the loop op
+        itself) inherit the longest common scope prefix of their callee
+        bodies — the scan *carry* is thereby attributed to the scan's scope
+        (``gpipe_scan``, ``tail_scan``…), which is exactly the O(parts)
+        state the memory campaigns chase."""
+        if ins.scope or not ins.callees or ins.opcode == "fusion":
+            return ins.scope
+        if ins.name in self._scope_cache:
+            return self._scope_cache[ins.name]
+        self._scope_cache[ins.name] = ""  # cycle guard
+        paths = []
+        for callee in ins.callees:
+            for sub in self.comps.get(callee, ()):
+                s = sub.scope or self.scope_of(sub)
+                if s:
+                    paths.append(s.split("/"))
+        scope = ""
+        if paths:
+            lcp: List[str] = []
+            for comps_at in zip(*paths):
+                if all(c == comps_at[0] for c in comps_at):
+                    lcp.append(comps_at[0])
+                else:
+                    break
+            if not lcp:
+                # Mixed bodies: fall back to the dominant first component.
+                heads: Dict[str, int] = {}
+                for p in paths:
+                    heads[p[0]] = heads.get(p[0], 0) + 1
+                lcp = [max(heads, key=lambda h: heads[h])]
+            scope = "/".join(lcp)
+        self._scope_cache[ins.name] = scope
+        return scope
+
+    def peak(self, comp: str, entry: bool = False
+             ) -> Tuple[int, List[LiveBuffer]]:
+        key = comp + ("#entry" if entry else "")
+        if key in self._cache:
+            return self._cache[key]
+        # Break cycles defensively (real HLO call graphs are acyclic).
+        self._cache[key] = (0, [])
+        result = self._peak_uncached(comp, entry)
+        self._cache[key] = result
+        return result
+
+    def _callee_peak(self, ins: Instr) -> Tuple[int, List[LiveBuffer]]:
+        if ins.opcode == "fusion" or not ins.callees:
+            return 0, []
+        best: Tuple[int, List[LiveBuffer]] = (0, [])
+        for callee in ins.callees:
+            if callee in self.comps:
+                p = self.peak(callee)
+                if p[0] > best[0]:
+                    best = p
+        return best
+
+    def _peak_uncached(self, comp: str, entry: bool
+                       ) -> Tuple[int, List[LiveBuffer]]:
+        instrs = self.comps.get(comp, [])
+        by_name = {i.name: i for i in instrs}
+        index = {i.name: k for k, i in enumerate(instrs)}
+
+        def underlying(name: str, seen=None) -> List[str]:
+            """Real (allocating) buffers a value aliases, through views."""
+            ins = by_name.get(name)
+            if ins is None:
+                return []
+            if not ins.is_view:
+                return [name]
+            if seen is None:
+                seen = set()
+            if name in seen:
+                return []
+            seen.add(name)
+            out: List[str] = []
+            for op in ins.operands:
+                out.extend(underlying(op, seen))
+            return out
+
+        # Live intervals for allocating instructions.  Parameters allocate
+        # only at the entry (category "argument", pinned live throughout);
+        # in callees they alias caller operands.  ``last_direct_use`` tracks
+        # every name (views included) for the dies-into scope fallback.
+        last_use: Dict[str, int] = {}
+        last_direct_use: Dict[str, int] = {}
+        for k, ins in enumerate(instrs):
+            for op in ins.operands:
+                last_direct_use[op] = k
+                for real in underlying(op):
+                    last_use[real] = k
+
+        def dying_scope(name: str, seen=None) -> str:
+            """Scope of the instruction a scope-less value dies into,
+            transitively through views and other scope-less consumers.
+            XLA-synthesized values (hoisted zero inits, mirror-param copies,
+            metadata-stripped constants) carry no op_name at all — but they
+            flow somewhere scoped (the scan while, a stage conditional), and
+            "the phase that consumes it" is the attribution the memory
+            campaigns need."""
+            if seen is None:
+                seen = set()
+            if name in seen:
+                return ""
+            seen.add(name)
+            k = last_direct_use.get(name)
+            if k is None:
+                return ""
+            consumer = instrs[k]
+            s = self.scope_of(consumer)
+            if s:
+                return s
+            return dying_scope(consumer.name, seen)
+
+        def buf_of(ins: Instr, category: str) -> LiveBuffer:
+            if category == "argument":
+                label = ins.op_name or ins.name.lstrip("%")
+                return LiveBuffer(
+                    name=ins.name, bytes=ins.bytes, shape=ins.shape,
+                    scope=f"{ARGS_SCOPE} {label}", category=category,
+                    op_name=ins.op_name,
+                )
+            return LiveBuffer(
+                name=ins.name, bytes=ins.bytes, shape=ins.shape,
+                scope=self.scope_of(ins) or dying_scope(ins.name),
+                category=category, op_name=ins.op_name,
+            )
+
+        def while_carry_bufs(ins: Instr) -> Optional[List[LiveBuffer]]:
+            """A ``while`` carry decomposed per element, each attributed to
+            the scope that PRODUCED its initial value.  The scan-carried
+            junction activations of the SPxPP engines thereby attribute to
+            ``junction_gather``/``stage_lineup`` — the phase that owns those
+            bytes — instead of lumping into the scan's own scope."""
+            if ins.opcode != "while" or len(ins.operands) != 1:
+                return None
+            init = by_name.get(ins.operands[0])
+            if init is None or init.opcode != "tuple":
+                return None
+            elem_shapes = re.findall(r"\w+\[[0-9,]*\](?:\{[0-9,]*\})?",
+                                     ins.shape)
+            if len(elem_shapes) != len(init.operands):
+                return None
+            fallback = self.scope_of(ins)
+            out = []
+            for shp, opnd in zip(elem_shapes, init.operands):
+                reals = underlying(opnd)
+                scope = ""
+                for r in reals:
+                    scope = self.scope_of(by_name[r])
+                    if scope:
+                        break
+                out.append(LiveBuffer(
+                    name=f"{ins.name}:{opnd}", bytes=shape_bytes(shp),
+                    shape=shp, scope=scope or fallback, category="temp",
+                    op_name=ins.op_name,
+                ))
+            return out
+
+        allocs: Dict[str, Tuple[int, str]] = {}  # name -> (def idx, category)
+        arg_bufs: List[LiveBuffer] = []
+        for k, ins in enumerate(instrs):
+            if ins.opcode == "parameter":
+                if entry:
+                    arg_bufs.append(buf_of(ins, "argument"))
+                continue
+            if ins.is_view or ins.bytes == 0:
+                continue
+            cat = "constant" if ins.opcode == "constant" else "temp"
+            allocs[ins.name] = (k, cat)
+
+        arg_total = sum(b.bytes for b in arg_bufs)
+        best_bytes, best_at = -1, -1
+        best_callee: List[LiveBuffer] = []
+        live_now = 0
+        # Sweep program points; maintain the running live-byte sum
+        # incrementally (O(n + uses)) instead of resumming per point.
+        starts: Dict[int, List[str]] = {}
+        ends: Dict[int, List[str]] = {}
+        for name, (d, _) in allocs.items():
+            starts.setdefault(d, []).append(name)
+            ends.setdefault(max(last_use.get(name, d), d), []).append(name)
+        for k, ins in enumerate(instrs):
+            for name in starts.get(k, ()):
+                live_now += by_name[name].bytes
+            point = live_now
+            callee_bytes, callee_set = self._callee_peak(ins)
+            if callee_bytes:
+                point += callee_bytes
+                # Operands dying into the call alias callee parameters /
+                # the while carry — don't count them twice.
+                dying = set()
+                for op in ins.operands:
+                    for real in underlying(op):
+                        if real in allocs and last_use.get(real) == k:
+                            dying.add(real)
+                point -= sum(by_name[r].bytes for r in dying)
+            else:
+                dying = set()
+            if point > best_bytes:
+                best_bytes, best_at = point, k
+                best_callee = callee_set
+                best_dying = dying
+            for name in ends.get(k, ()):
+                live_now -= by_name[name].bytes
+        if best_at < 0:  # empty computation
+            return arg_total, list(arg_bufs)
+
+        live_set: List[LiveBuffer] = list(arg_bufs)
+        for name, (d, cat) in allocs.items():
+            if name in best_dying and self._callee_peak(instrs[best_at])[0]:
+                continue
+            if d <= best_at <= max(last_use.get(name, d), d):
+                ins = by_name[name]
+                carry = while_carry_bufs(ins)
+                if carry is not None:
+                    live_set.extend(carry)
+                else:
+                    live_set.append(buf_of(ins, cat))
+        # Callee-internal buffers without a scope of their own belong to the
+        # call site: rebadge them with the calling instruction's (inherited)
+        # scope.  Copies are cheap and keep the per-callee cache intact.
+        call_ins = instrs[best_at]
+        call_scope = (self.scope_of(call_ins)
+                      or dying_scope(call_ins.name)) if best_callee else ""
+        for b in best_callee:
+            if not b.scope and call_scope:
+                b = dataclasses.replace(b, scope=call_scope)
+            live_set.append(b)
+        return best_bytes + arg_total, live_set
+
+
+# ---------------------------------------------------------------------------
+# Public surface
+# ---------------------------------------------------------------------------
+
+
+def attribute_hlo(hlo_text: str, top: int = 20) -> dict:
+    """Per-scope peak-HBM breakdown of one compiled HLO module's text.
+
+    Returns a JSON-ready dict::
+
+        peak_bytes_est      analytical peak (liveness over the schedule)
+        by_scope            {scope: bytes at peak} — "(args) <name>" entries
+                            for entry arguments, "(unattributed)" for buffers
+                            whose metadata carries no obs.scope path
+        top_buffers         largest-N live-at-peak buffers (name/shape/
+                            scope/category/bytes)
+        coverage            attributed bytes / peak bytes  (arguments and
+                            scoped temps both count as attributed)
+        scoped_temp_coverage  scoped temp bytes / all temp bytes at peak
+    """
+    comps, entry = parse_hlo_module(hlo_text)
+    if not entry:
+        raise ValueError("no ENTRY computation found in HLO text")
+    peak, live = _ModulePeak(comps).peak(entry, entry=True)
+
+    by_scope: Dict[str, int] = {}
+    temp_total = temp_scoped = attributed = 0
+    for b in live:
+        key = b.scope or UNATTRIBUTED
+        by_scope[key] = by_scope.get(key, 0) + b.bytes
+        if b.category == "temp":
+            temp_total += b.bytes
+            if b.scope:
+                temp_scoped += b.bytes
+        if b.scope:
+            attributed += b.bytes
+    live_sorted = sorted(live, key=lambda b: -b.bytes)
+    return {
+        "peak_bytes_est": peak,
+        "by_scope": dict(sorted(by_scope.items(), key=lambda kv: -kv[1])),
+        "top_buffers": [
+            {"name": b.name, "bytes": b.bytes, "shape": b.shape,
+             "scope": b.scope or UNATTRIBUTED, "category": b.category}
+            for b in live_sorted[:top]
+        ],
+        "coverage": round(attributed / peak, 4) if peak else 1.0,
+        "scoped_temp_coverage": (
+            round(temp_scoped / temp_total, 4) if temp_total else 1.0
+        ),
+        "live_buffers": len(live),
+    }
+
+
+def attribute_compiled(compiled, top: int = 20,
+                       hlo_text: Optional[str] = None) -> dict:
+    """:func:`attribute_hlo` of a ``jax.stages.Compiled``, reconciled against
+    its ``memory_analysis()`` (the ``reconcile`` sub-dict: XLA's own totals
+    and the estimate/actual ratio the tests bound).  Pass ``hlo_text`` when
+    the caller already has ``compiled.as_text()`` — serializing the module
+    is the dominant non-compile cost on flagship-sized programs."""
+    out = attribute_hlo(hlo_text if hlo_text is not None
+                        else compiled.as_text(), top=top)
+    try:
+        ma = compiled.memory_analysis()
+        actual = (
+            int(ma.temp_size_in_bytes) + int(ma.argument_size_in_bytes)
+            - int(ma.alias_size_in_bytes)
+        )
+        out["reconcile"] = {
+            "memory_analysis_peak_bytes": actual,
+            "temp_bytes": int(ma.temp_size_in_bytes),
+            "argument_bytes": int(ma.argument_size_in_bytes),
+            "ratio_est_over_actual": (
+                round(out["peak_bytes_est"] / actual, 3) if actual else None
+            ),
+        }
+    except Exception:  # noqa: BLE001 — backends without memory_analysis
+        out["reconcile"] = None
+    return out
+
+
+def top_scope(breakdown: dict, prefixes: Optional[Tuple[str, ...]] = None
+              ) -> Optional[str]:
+    """The scope owning the most peak bytes (arguments and unattributed
+    excluded — the question is which *phase* owns the working set).  With
+    ``prefixes``, restricted to scopes starting with one of them."""
+    best_key, best_val = None, -1
+    for k, v in breakdown.get("by_scope", {}).items():
+        if k == UNATTRIBUTED or k.startswith(ARGS_SCOPE):
+            continue
+        if prefixes and not any(k.startswith(p) for p in prefixes):
+            continue
+        if v > best_val:
+            best_key, best_val = k, v
+    return best_key
+
+
+def scope_group_bytes(breakdown: dict, depth: int = 1) -> Dict[str, int]:
+    """``by_scope`` rolled up to the first ``depth`` path components
+    (``sp_region/sp_level0/cell03`` -> ``sp_region``) — the phase-level view
+    the CI plurality gate reads."""
+    out: Dict[str, int] = {}
+    for k, v in breakdown.get("by_scope", {}).items():
+        if k == UNATTRIBUTED or k.startswith(ARGS_SCOPE):
+            key = k
+        else:
+            key = "/".join(k.split("/")[:depth])
+        out[key] = out.get(key, 0) + v
+    return dict(sorted(out.items(), key=lambda kv: -kv[1]))
+
+
+def compare_breakdowns(a: dict, b: dict) -> dict:
+    """A/B delta of two breakdowns: per-scope byte deltas (B minus A),
+    sorted by absolute delta, plus the peak delta."""
+    sa, sb = a.get("by_scope", {}), b.get("by_scope", {})
+    deltas = {
+        k: sb.get(k, 0) - sa.get(k, 0)
+        for k in set(sa) | set(sb)
+        if sb.get(k, 0) != sa.get(k, 0)
+    }
+    return {
+        "peak_delta_bytes": a and b and (
+            b.get("peak_bytes_est", 0) - a.get("peak_bytes_est", 0)
+        ),
+        "by_scope_delta": dict(
+            sorted(deltas.items(), key=lambda kv: -abs(kv[1]))
+        ),
+    }
+
+
+def _gb(n: int) -> str:
+    if abs(n) >= 2**30:
+        return f"{n / 2**30:.2f} GB"
+    if abs(n) >= 2**20:
+        return f"{n / 2**20:.1f} MB"
+    return f"{n / 2**10:.1f} KB"
+
+
+def format_breakdown(breakdown: dict, top: int = 12) -> str:
+    """Human-readable table of one breakdown (the mem_probe --attribute and
+    ``obs report`` rendering)."""
+    peak = breakdown["peak_bytes_est"]
+    lines = [
+        f"peak (analytical liveness over the schedule): {_gb(peak)}  "
+        f"coverage {breakdown['coverage']:.1%} "
+        f"(scoped temps {breakdown['scoped_temp_coverage']:.1%})"
+    ]
+    rec = breakdown.get("reconcile")
+    if rec:
+        lines.append(
+            f"memory_analysis peak: {_gb(rec['memory_analysis_peak_bytes'])} "
+            f"(est/actual {rec['ratio_est_over_actual']})"
+        )
+    lines.append("per-scope peak bytes:")
+    for k, v in list(breakdown["by_scope"].items())[:top]:
+        lines.append(f"  {_gb(v):>10}  {100 * v / peak:5.1f}%  {k}")
+    lines.append("largest live buffers at peak:")
+    for b in breakdown["top_buffers"][:top]:
+        lines.append(
+            f"  {_gb(b['bytes']):>10}  {b['category']:<8} "
+            f"{b['shape'][:40]:<40} {b['scope']}"
+        )
+    return "\n".join(lines)
+
+
+def format_delta(delta: dict, top: int = 12) -> str:
+    lines = [f"peak delta: {_gb(delta.get('peak_delta_bytes') or 0)} (B - A)"]
+    for k, v in list(delta["by_scope_delta"].items())[:top]:
+        lines.append(f"  {'+' if v >= 0 else ''}{_gb(v):>10}  {k}")
+    return "\n".join(lines)

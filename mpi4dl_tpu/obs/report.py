@@ -8,11 +8,12 @@ from crashed runs that never wrote a summary record.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from mpi4dl_tpu.obs.costs import mfu
 from mpi4dl_tpu.obs.hlo_stats import COLLECTIVE_CLASSES
 from mpi4dl_tpu.obs.runlog import read_runlog
+from mpi4dl_tpu.obs.timeline import bubble_fraction, pipeline_ticks
 # Same interpolation as StepMeter.stats(), so report percentiles of the raw
 # step records always match a run's own summary record.
 from mpi4dl_tpu.utils.misc import _percentile as _pct
@@ -129,20 +130,12 @@ def render_run(path: str) -> str:
     if split > 1 and (meta or {}).get("family") != "single":
         parts_n = int(cfg.get("parts") or 1)
         schedule = cfg.get("schedule") or "gpipe"
-        if schedule == "1f1b":
-            # One fwd AND one bwd micro-batch per tick; fill+drain covers
-            # both directions.
-            ticks = parts_n + 2 * (split - 1)
-            bubble = 2 * (split - 1) / (parts_n + 2 * (split - 1))
-        elif schedule == "gpipe":
-            ticks = parts_n + split - 1
-            bubble = (split - 1) / ticks
-        else:
-            # Not a schedule the tick arithmetic knows (e.g. mem_probe's
-            # multi-schedule sweeps record schedule="both") — don't render
-            # one schedule's numbers under another's name.
-            ticks = None
-            bubble = None
+        # Canonical tick/bubble arithmetic lives in obs/timeline.py; unknown
+        # schedules (e.g. mem_probe's multi-schedule sweeps record
+        # schedule="both") yield None — don't render one schedule's numbers
+        # under another's name.
+        ticks = pipeline_ticks(schedule, split, parts_n)
+        bubble = bubble_fraction(schedule, split, parts_n)
         line = f"pipeline: schedule={schedule}  stages={split}  parts={parts_n}"
         if ticks is not None:
             line += f"  ticks/step={ticks}  bubble={bubble:.3f}"
@@ -204,8 +197,173 @@ def render_run(path: str) -> str:
                 f"  {'total':<19} count {coll.get('total_count', 0):>4}  "
                 f"bytes {_fmt_bytes(coll.get('total_bytes', 0))}"
             )
+
+    # -- mem_probe / HBM attribution / timeline / junction sweep -----------
+    probe = _first(records, "mem_probe")
+    if probe is not None and probe.get("table"):
+        lines.append("mem_probe (compile-only peak HBM):")
+        lines.extend("  " + ln for ln in str(probe["table"]).splitlines())
+    if probe is not None and probe.get("parts_delta"):
+        pd = probe["parts_delta"]
+        for sched, d in (pd.get("per_schedule") or {}).items():
+            lines.append(
+                f"O(parts) growth [{sched}] parts {pd.get('parts_a')} -> "
+                f"{pd.get('parts_b')} (top group: "
+                f"{d.get('top_growth_group')}):"
+            )
+            for k, v in list(
+                (d.get("growth_bytes_per_part") or {}).items()
+            )[:6]:
+                lines.append(f"  {_fmt_bytes(v):>10}/part  {k}")
+    for rec in records:
+        if rec.get("kind") != "hbm":
+            continue
+        bd = rec.get("breakdown") or {}
+        label = rec.get("label")
+        lines.append(
+            "hbm attribution" + (f" [{label}]" if label else "") + ": peak "
+            f"{_fmt_bytes(bd.get('peak_bytes_est'))} (analytical), coverage "
+            f"{bd.get('coverage', 0):.1%}"
+        )
+        for k, v in list((bd.get("by_scope") or {}).items())[:6]:
+            lines.append(f"  {_fmt_bytes(v):>10}  {k}")
+    tl = _first(records, "timeline")
+    if tl is not None:
+        lines.append(
+            f"analytical timeline: serialized {tl.get('serialized_ms')} ms "
+            f"(compute {tl.get('compute_ms')} + collectives "
+            f"{tl.get('collective_ms')}), perfect overlap "
+            f"{tl.get('overlapped_ms')} ms — headroom "
+            f"{tl.get('overlap_headroom_ms')} ms"
+        )
+    sweep = _first(records, "junction_sweep")
+    if sweep is not None:
+        lines.append(
+            "junction placement frontier (spatial_until -> peak GB/device):"
+        )
+        for p in sweep.get("placements") or []:
+            mark = " <-- best" if p.get("best") else ""
+            lines.append(
+                f"  spatial_until={p.get('spatial_until'):>3}  "
+                f"{p.get('peak_gb_est')} GB{mark}"
+            )
     return "\n".join(lines)
 
 
 def render(paths: Sequence[str]) -> str:
     return "\n\n".join(render_run(p) for p in paths)
+
+
+# ---------------------------------------------------------------------------
+# A/B regression compare (the perf gate over RunLog artifacts)
+# ---------------------------------------------------------------------------
+
+# metric name -> (direction, extractor).  Direction "lower"/"higher" is the
+# GOOD direction; a move in the other direction beyond the threshold is a
+# regression breach.
+def _median_ms(records: List[dict]) -> Optional[float]:
+    ms = sorted(
+        float(r["ms"]) for r in records
+        if r.get("kind") == "step" and r.get("measured", True)
+    )
+    return _pct(ms, 0.5) if ms else None
+
+
+def _mean_ips(records: List[dict]) -> Optional[float]:
+    ips = [
+        float(r["images_per_sec"]) for r in records
+        if r.get("kind") == "step" and r.get("measured", True)
+    ]
+    return sum(ips) / len(ips) if ips else None
+
+
+def _peak_hbm(records: List[dict]) -> Optional[float]:
+    peaks = [
+        r["memory_peak_bytes"] for r in records
+        if r.get("kind") == "step" and r.get("memory_peak_bytes") is not None
+    ]
+    if peaks:
+        return max(peaks)
+    # Compile-only artifacts fall back to the analytical liveness estimate.
+    # Never mixed with measured watermarks: the estimate over-counts by a
+    # documented 1.1-2.4x (obs/hbm.py), so max() across the two kinds would
+    # compare incomparable quantities between an instrumented and a plain
+    # run.
+    est = [
+        r["breakdown"]["peak_bytes_est"] for r in records
+        if r.get("kind") == "hbm"
+        and (r.get("breakdown") or {}).get("peak_bytes_est")
+    ]
+    return max(est) if est else None
+
+
+def _coll_bytes(records: List[dict]) -> Optional[float]:
+    for r in records:
+        if r.get("kind") == "cost" and (r.get("collectives") or {}).get(
+            "total_bytes"
+        ) is not None:
+            return float(r["collectives"]["total_bytes"])
+    return None
+
+
+def _probe_peak_gb(records: List[dict]) -> Optional[float]:
+    for r in records:
+        if r.get("kind") == "mem_probe":
+            rows = r.get("schedules") or {}
+            vals = [
+                v.get("peak_gb_est") for v in rows.values()
+                if isinstance(v, dict) and v.get("peak_gb_est") is not None
+            ]
+            if vals:
+                return min(vals)
+            if r.get("peak_gb_est") is not None:
+                return float(r["peak_gb_est"])
+    return None
+
+
+_COMPARE_METRICS = [
+    ("step ms (median)", "lower", _median_ms),
+    ("images/sec (mean)", "higher", _mean_ips),
+    ("peak HBM bytes", "lower", _peak_hbm),
+    ("collective bytes/step", "lower", _coll_bytes),
+    ("mem_probe peak GB", "lower", _probe_peak_gb),
+]
+
+
+def compare_runs(path_a: str, path_b: str,
+                 threshold_pct: float = 5.0) -> Tuple[str, int]:
+    """Per-metric regression diff of two RunLog files (A = baseline,
+    B = candidate).  Returns ``(report text, breach count)`` — a breach is a
+    metric that moved against its good direction by more than
+    ``threshold_pct`` percent.  Metrics absent from either file are skipped
+    (reported as such), so a compile-only probe artifact and a full
+    benchmark run can still be compared on their shared metrics."""
+    ra, rb = read_runlog(path_a), read_runlog(path_b)
+    lines = [f"== compare  A: {path_a}  ->  B: {path_b}  "
+             f"(threshold {threshold_pct:g}%)"]
+    breaches = 0
+    for name, good, fn in _COMPARE_METRICS:
+        va, vb = fn(ra), fn(rb)
+        if va is None or vb is None:
+            lines.append(f"  {name:<24} n/a (missing in "
+                         f"{'A' if va is None else 'B'})")
+            continue
+        if va == 0:
+            delta_pct = 0.0 if vb == 0 else float("inf")
+        else:
+            delta_pct = (vb - va) / abs(va) * 100.0
+        regressed = (
+            delta_pct > threshold_pct if good == "lower"
+            else delta_pct < -threshold_pct
+        )
+        flag = "  REGRESSION" if regressed else ""
+        breaches += int(regressed)
+        lines.append(
+            f"  {name:<24} {va:>14.4g} -> {vb:>14.4g}  "
+            f"({delta_pct:+.2f}%){flag}"
+        )
+    lines.append(
+        f"{breaches} regression(s) beyond threshold" if breaches
+        else "no regressions beyond threshold"
+    )
+    return "\n".join(lines), breaches

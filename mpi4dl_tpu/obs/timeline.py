@@ -1,0 +1,298 @@
+"""Analytical per-scope step timeline from the compiled artifact.
+
+The companion of obs/hbm.py (same parsed module, the time axis instead of
+the byte axis): for every ``obs.scope`` in the compiled HLO, an analytical
+**compute-time** estimate (conv/dot FLOPs at the instruction's shapes over
+the chip's bf16 peak, :data:`~mpi4dl_tpu.obs.costs.PEAK_BF16_FLOPS`) and a
+**collective-time** estimate (collective payload bytes over the chip's ICI
+bandwidth, :func:`~mpi4dl_tpu.obs.costs.ici_bytes_per_s`), rolled into a
+serialized-vs-overlappable report: the serialized total assumes no
+compute/communication overlap, the overlapped bound assumes perfect overlap
+— the gap is the budget the T3-style halo-RDMA work (ROADMAP item 2, arXiv
+2401.16677) can win, now measurable per scope before any silicon run.
+
+Also the canonical home of the pipeline-schedule tick/bubble arithmetic
+(:func:`pipeline_ticks` / :func:`bubble_fraction`, docs/pipeline.md):
+obs/report.py renders from these, and the readiness/probing tools reuse them
+for bubble accounting instead of re-deriving the formulas.
+
+Estimates are *analytical*: XLA fusion, layout, and memory-bound ops are not
+modeled (a scope with zero conv/dot FLOPs can still burn wall-clock on
+element-wise work).  Use them for ranking scopes and for overlap headroom,
+not as wall-clock predictions — the RunLog's measured step records stay the
+ground truth.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional
+
+from mpi4dl_tpu.obs.costs import (
+    DEFAULT_ICI_BYTES_PER_S,
+    ici_bytes_per_s,
+    peak_flops,
+)
+from mpi4dl_tpu.obs.hbm import Instr, parse_hlo_module, shape_bytes
+
+_COLLECTIVE_OPS = {
+    "collective-permute": "collective-permute",
+    "collective-permute-start": "collective-permute",
+    "all-reduce": "all-reduce",
+    "all-reduce-start": "all-reduce",
+    "all-gather": "all-gather",
+    "all-gather-start": "all-gather",
+    "reduce-scatter": "reduce-scatter",
+    "reduce-scatter-start": "reduce-scatter",
+    "all-to-all": "all-to-all",
+    "all-to-all-start": "all-to-all",
+}
+
+_DIMS = re.compile(r"\[([0-9,]*)\]")
+
+
+def _dims(shape: str) -> List[int]:
+    m = _DIMS.search(shape)
+    if not m:
+        return []
+    return [int(d) for d in m.group(1).split(",") if d]
+
+
+def _prod(xs) -> int:
+    n = 1
+    for x in xs:
+        n *= x
+    return n
+
+
+def instr_flops(ins: Instr, line_attrs: str = "") -> float:
+    """Analytical FLOPs of one HLO instruction (0 for non-conv/dot ops).
+
+    conv: 2 x out_elems x (kernel elements / out_features) — the per-output
+    MAC count; kernel shape already folds in ``feature_group_count`` (its
+    input-feature dim is per-group), so grouped/depthwise convs are right.
+    dot: 2 x out_elems x contracted extent (from ``lhs_contracting_dims``).
+    """
+    # Operand shapes live after the opcode's '(' — slicing there keeps the
+    # defined (output) shape out of the operand-shape scan.
+    cut = line_attrs.find(ins.opcode + "(")
+    operand_text = line_attrs[cut:] if cut >= 0 else line_attrs
+    if ins.opcode == "convolution":
+        out = _dims(ins.shape)
+        # The kernel is the second operand.
+        shapes = re.findall(r"\w+\[[0-9,]*\]", operand_text)
+        if len(shapes) < 2 or not out:
+            return 0.0
+        kernel = _dims(shapes[1])
+        m = re.search(r"->([b01-9f]+)", line_attrs)
+        # Output feature dim position from dim_labels ("->b01f": f last).
+        out_features = out[-1]
+        if m and "f" in m.group(1):
+            out_features = out[m.group(1).index("f")]
+        if not kernel or not out_features:
+            return 0.0
+        return 2.0 * _prod(out) * _prod(kernel) / out_features
+    if ins.opcode == "dot":
+        out = _dims(ins.shape)
+        shapes = re.findall(r"\w+\[[0-9,]*\]", operand_text)
+        if not shapes:
+            return 0.0
+        lhs = _dims(shapes[0])
+        m = re.search(r"lhs_contracting_dims=\{([0-9,]*)\}", line_attrs)
+        contract = [int(d) for d in m.group(1).split(",") if d] if m else []
+        k = _prod(lhs[c] for c in contract if c < len(lhs)) if contract else 1
+        return 2.0 * _prod(out) * k
+    return 0.0
+
+
+def hlo_scope_costs(hlo_text: str) -> Dict[str, Dict[str, float]]:
+    """Per-scope ``{flops, collective_bytes, collective_count}`` from one
+    compiled HLO module's text.  Scope keys are the obs.scope vocabulary
+    (:func:`~mpi4dl_tpu.obs.hlo_stats.clean_scope_path`); ops without a
+    scope path aggregate under ``""``.  Walks every computation (fusion
+    bodies carry the conv/dot instructions' metadata), counting async
+    collective ``-start``/``-done`` pairs once."""
+    comps, _ = parse_hlo_module(hlo_text)
+    # Re-scan the raw text per instruction name for attribute strings the
+    # Instr dataclass doesn't keep (window/dim_labels/contracting dims).
+    attr_by_name: Dict[str, str] = {}
+    for line in hlo_text.splitlines():
+        m = re.match(r"\s*(?:ROOT\s+)?(%[\w.\-]+)\s*=", line)
+        if m:
+            attr_by_name[m.group(1)] = line
+    out: Dict[str, Dict[str, float]] = {}
+
+    def bucket(scope: str) -> Dict[str, float]:
+        return out.setdefault(scope, {
+            "flops": 0.0, "collective_bytes": 0, "collective_count": 0,
+        })
+
+    for instrs in comps.values():
+        for ins in instrs:
+            if ins.opcode in ("convolution", "dot"):
+                fl = instr_flops(ins, attr_by_name.get(ins.name, ""))
+                if fl:
+                    bucket(ins.scope)["flops"] += fl
+            elif ins.opcode in _COLLECTIVE_OPS:
+                b = bucket(ins.scope)
+                nbytes = ins.bytes
+                if ins.opcode.endswith("-start"):
+                    # Start tuples are (operand, result[, ctx]); count the
+                    # result payload, matching hlo_collective_stats.
+                    shapes = re.findall(r"\w+\[[0-9,]*\]", ins.shape)
+                    if len(shapes) > 1:
+                        nbytes = shape_bytes(shapes[1])
+                b["collective_bytes"] += nbytes
+                b["collective_count"] += 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pipeline schedule arithmetic (canonical home; docs/pipeline.md derivations)
+# ---------------------------------------------------------------------------
+
+
+def pipeline_ticks(schedule: str, stages: int, parts: int) -> Optional[int]:
+    """Scan ticks per optimizer step.  GPipe: ``parts + S - 1`` forward-ish
+    ticks (each tick one micro-batch through one stage).  1F1B: each tick
+    runs one fwd AND one bwd micro-batch, and fill+drain cover both
+    directions: ``parts + 2(S - 1)``.  None for unknown schedules."""
+    if schedule == "gpipe":
+        return parts + stages - 1
+    if schedule == "1f1b":
+        return parts + 2 * (stages - 1)
+    return None
+
+
+def bubble_fraction(schedule: str, stages: int, parts: int) -> Optional[float]:
+    """Idle-tick fraction of the schedule: ``(ticks - parts) / ticks`` —
+    GPipe ``(S-1)/(parts+S-1)``, 1F1B ``2(S-1)/(parts+2(S-1))`` (the
+    docs/pipeline.md crossover arithmetic)."""
+    ticks = pipeline_ticks(schedule, stages, parts)
+    if ticks is None or ticks <= 0:
+        return None
+    return (ticks - parts) / ticks
+
+
+# ---------------------------------------------------------------------------
+# The timeline report
+# ---------------------------------------------------------------------------
+
+
+def analytical_timeline(
+    hlo_text: str,
+    *,
+    peak: Optional[float] = None,
+    ici_bw: Optional[float] = None,
+    device=None,
+    schedule: Optional[str] = None,
+    stages: Optional[int] = None,
+    parts: Optional[int] = None,
+) -> dict:
+    """Serialized-vs-overlappable analytical timeline of one compiled step.
+
+    ``peak``/``ici_bw`` default from ``device`` (CPU hosts get the labeled
+    nominal constants — comparable run-over-run, explicitly not a hardware
+    claim).  With ``schedule``/``stages``/``parts``, adds the pipeline
+    bubble accounting.  Returns a JSON-ready dict (the ``timeline`` RunLog
+    record; render with :func:`format_timeline`)."""
+    peak_src = ici_src = "given"
+    if peak is None:
+        peak, peak_src = peak_flops(device, allow_cpu_nominal=True) \
+            if device is not None else (None, None)
+    if ici_bw is None:
+        if device is not None:
+            ici_bw, ici_src = ici_bytes_per_s(device)
+        else:
+            ici_bw, ici_src = DEFAULT_ICI_BYTES_PER_S, "default"
+
+    costs = hlo_scope_costs(hlo_text)
+    rows = []
+    tot_compute_ms = tot_coll_ms = 0.0
+    tot_flops = 0.0
+    tot_bytes = 0
+    for scope, c in costs.items():
+        compute_ms = (c["flops"] / peak * 1e3) if peak else None
+        coll_ms = (
+            c["collective_bytes"] / ici_bw * 1e3 if ici_bw else None
+        )
+        tot_flops += c["flops"]
+        tot_bytes += int(c["collective_bytes"])
+        tot_compute_ms += compute_ms or 0.0
+        tot_coll_ms += coll_ms or 0.0
+        rows.append({
+            "scope": scope or "(unattributed)",
+            "flops": c["flops"],
+            "compute_ms": round(compute_ms, 4) if compute_ms is not None else None,
+            "collective_bytes": int(c["collective_bytes"]),
+            "collective_count": int(c["collective_count"]),
+            "collective_ms": round(coll_ms, 4) if coll_ms is not None else None,
+        })
+    rows.sort(key=lambda r: -((r["compute_ms"] or 0) + (r["collective_ms"] or 0)))
+
+    serialized = tot_compute_ms + tot_coll_ms
+    overlapped = max(tot_compute_ms, tot_coll_ms)
+    out = {
+        "rows": rows,
+        "total_flops": tot_flops,
+        "total_collective_bytes": tot_bytes,
+        "compute_ms": round(tot_compute_ms, 4),
+        "collective_ms": round(tot_coll_ms, 4),
+        "serialized_ms": round(serialized, 4),
+        "overlapped_ms": round(overlapped, 4),
+        "overlap_headroom_ms": round(serialized - overlapped, 4),
+        "peak_flops": peak,
+        "peak_source": peak_src,
+        "ici_bytes_per_s": ici_bw,
+        "ici_source": ici_src,
+    }
+    if schedule and stages and parts:
+        ticks = pipeline_ticks(schedule, stages, parts)
+        bubble = bubble_fraction(schedule, stages, parts)
+        out["pipeline"] = {
+            "schedule": schedule, "stages": stages, "parts": parts,
+            "ticks": ticks, "bubble_fraction": bubble,
+            # Bubble-adjusted wall estimate: the serialized estimate is
+            # per-step work; idle ticks stretch it by 1/(1-bubble).
+            "bubble_adjusted_serialized_ms": (
+                round(serialized / (1 - bubble), 4)
+                if bubble is not None and bubble < 1 else None
+            ),
+        }
+    return out
+
+
+def format_timeline(tl: dict, top: int = 12) -> str:
+    lines = [
+        f"analytical timeline (peak {tl['peak_flops']:.3g} FLOP/s "
+        f"[{tl['peak_source']}], ICI {tl['ici_bytes_per_s']:.3g} B/s "
+        f"[{tl['ici_source']}])"
+        if tl.get("peak_flops") else
+        "analytical timeline (no peak FLOPs — collective times only)",
+        f"serialized {tl['serialized_ms']:.3f} ms = compute "
+        f"{tl['compute_ms']:.3f} + collectives {tl['collective_ms']:.3f}; "
+        f"perfect overlap {tl['overlapped_ms']:.3f} ms "
+        f"(headroom {tl['overlap_headroom_ms']:.3f} ms)",
+    ]
+    pipe = tl.get("pipeline")
+    if pipe:
+        lines.append(
+            f"pipeline: {pipe['schedule']} stages={pipe['stages']} "
+            f"parts={pipe['parts']} ticks={pipe['ticks']} "
+            f"bubble={pipe['bubble_fraction']:.3f}"
+            + (
+                f"  bubble-adjusted {pipe['bubble_adjusted_serialized_ms']:.3f} ms"
+                if pipe.get("bubble_adjusted_serialized_ms") is not None else ""
+            )
+        )
+    lines.append(
+        f"{'scope':<44} {'compute_ms':>10} {'coll_ms':>8} {'coll_bytes':>12}"
+    )
+    for r in tl["rows"][:top]:
+        lines.append(
+            f"{r['scope'][:44]:<44} "
+            f"{(r['compute_ms'] if r['compute_ms'] is not None else 0):>10.4f} "
+            f"{(r['collective_ms'] if r['collective_ms'] is not None else 0):>8.4f} "
+            f"{r['collective_bytes']:>12}"
+        )
+    return "\n".join(lines)

@@ -151,9 +151,10 @@ def _apply_fused_triple(cv: Conv2d, bn: BatchNorm, p_conv, p_bn, x, ctx,
     )
     if sub.active and sub.bn_cross_tile:
         ax_names = tuple(a for a in (sub.axis_h, sub.axis_w) if a)
-        cnt = lax.psum(cnt, ax_names)
-        s = lax.psum(s, ax_names)
-        ss = lax.psum(ss, ax_names)
+        with scope("bn_cross_tile"):
+            cnt = lax.psum(cnt, ax_names)
+            s = lax.psum(s, ax_names)
+            ss = lax.psum(ss, ax_names)
     mean = s / cnt
     var = jnp.maximum(ss / cnt - mean * mean, 0.0)
     y = bn.normalize_with_stats(

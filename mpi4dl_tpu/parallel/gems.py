@@ -134,27 +134,31 @@ def make_gems_train_step(
                         compute_dtype=compute_dtype,
                     )
             denom = 2 * times * Pn
-            loss = lax.psum(loss_acc, AXIS_STAGE) / denom
-            acc = lax.psum(acc_acc, AXIS_STAGE) / denom
-            if grad_axes:
-                loss = lax.pmean(loss, grad_axes)
-                acc = lax.pmean(acc, grad_axes)
+            with scope("loss_reduce"):
+                loss = lax.psum(loss_acc, AXIS_STAGE) / denom
+                acc = lax.psum(acc_acc, AXIS_STAGE) / denom
+                if grad_axes:
+                    loss = lax.pmean(loss, grad_axes)
+                    acc = lax.pmean(acc, grad_axes)
             # Stream B's stats belong to stage S-1-d: route them home via the
             # mirror permute, then average over all 2*times*Pn deposits (each
             # stream contributed times*Pn).
-            stats = (stA + lax.ppermute(stB, AXIS_STAGE, mirror_perm)) / denom
+            with scope("stats_mirror"):
+                stats = (stA + lax.ppermute(stB, AXIS_STAGE, mirror_perm)) / denom
             return loss, (acc, stats)
 
         (loss, (acc, stats)), grads = jax.value_and_grad(
             loss_and_metrics, has_aux=True
         )(flat_params)
         if grad_axes:
-            grads = lax.pmean(grads, grad_axes)
+            with scope("grad_reduce"):
+                grads = lax.pmean(grads, grad_axes)
         with scope("optimizer_update"):
             new_flat, new_opt = optimizer.update(flat_params, grads, opt_local)
         if with_stats:
             if grad_axes:
-                stats = lax.pmean(stats, grad_axes)
+                with scope("stats_reduce"):
+                    stats = lax.pmean(stats, grad_axes)
             new_flat = scatter_stage_stats(part, new_flat, stats)
         return (
             new_flat[None],

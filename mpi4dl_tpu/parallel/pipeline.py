@@ -154,11 +154,12 @@ def make_pipeline_train_step(
                     )
             # Only the last stage accumulated; psum broadcasts to all stages
             # (and sums over data-parallel groups' mean below).
-            loss = lax.psum(loss_acc, AXIS_STAGE) / Pn
-            acc = lax.psum(acc_acc, AXIS_STAGE) / Pn
-            if grad_axes:
-                loss = lax.pmean(loss, grad_axes)
-                acc = lax.pmean(acc, grad_axes)
+            with scope("loss_reduce"):
+                loss = lax.psum(loss_acc, AXIS_STAGE) / Pn
+                acc = lax.psum(acc_acc, AXIS_STAGE) / Pn
+                if grad_axes:
+                    loss = lax.pmean(loss, grad_axes)
+                    acc = lax.pmean(acc, grad_axes)
             return loss * loss_scale, (acc, st_acc / Pn)
 
         (loss, (acc, stats)), grads = jax.value_and_grad(
@@ -168,12 +169,14 @@ def make_pipeline_train_step(
             grads = grads / loss_scale
             loss = loss / loss_scale
         if grad_axes:
-            grads = lax.pmean(grads, grad_axes)
+            with scope("grad_reduce"):
+                grads = lax.pmean(grads, grad_axes)
         with scope("optimizer_update"):
             new_flat, new_opt = optimizer.update(flat_params, grads, opt_local)
         if with_stats:
             if grad_axes:
-                stats = lax.pmean(stats, grad_axes)
+                with scope("stats_reduce"):
+                    stats = lax.pmean(stats, grad_axes)
             new_flat = scatter_stage_stats(part, new_flat, stats)
         return (
             new_flat[None],

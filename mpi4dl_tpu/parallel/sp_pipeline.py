@@ -332,7 +332,7 @@ def _make_sp_step(
         act = apply_junction(act, sp_last, spp.junction, degree)
 
         # Line all stage chunks up in batch order on every device.
-        def g(t):
+        def g(t):  # analysis: ok(unscoped-collective) — applied under scope("stage_lineup") below
             t = lax.all_gather(t, AXIS_STAGE, axis=0, tiled=True)
             return t.reshape(*lead_shape, spp.mb_tail, *t.shape[1:])
 
@@ -368,14 +368,15 @@ def _make_sp_step(
                 loss_acc, acc_acc, tail_stats = scan_fn(
                     branches, tail_flat, x_parts, y_parts, vary_axes
                 )
-            loss = lax.psum(loss_acc, AXIS_STAGE) / denom
-            acc = lax.psum(acc_acc, AXIS_STAGE) / denom
-            if tile_axes:
-                loss = lax.pmean(loss, tile_axes)
-                acc = lax.pmean(acc, tile_axes)
-            if grad_axes:
-                loss = lax.pmean(loss, grad_axes)
-                acc = lax.pmean(acc, grad_axes)
+            with scope("loss_reduce"):
+                loss = lax.psum(loss_acc, AXIS_STAGE) / denom
+                acc = lax.psum(acc_acc, AXIS_STAGE) / denom
+                if tile_axes:
+                    loss = lax.pmean(loss, tile_axes)
+                    acc = lax.pmean(acc, tile_axes)
+                if grad_axes:
+                    loss = lax.pmean(loss, grad_axes)
+                    acc = lax.pmean(acc, grad_axes)
             return loss, (acc, sp_stats, tail_stats)
 
         (loss, (acc, sp_stats, tail_stats)), (g_sp, g_tail) = jax.value_and_grad(
@@ -384,13 +385,14 @@ def _make_sp_step(
 
         # Identity-on-value invariance bookkeeping (derivation in the module
         # docstring: AD already psum'd these cotangents home):
-        g_sp = lax.pmean(g_sp, AXIS_STAGE)
-        if tile_axes:
-            g_sp = lax.pmean(g_sp, tile_axes)
-            g_tail = lax.pmean(g_tail, tile_axes)
-        if grad_axes:
-            g_sp = lax.pmean(g_sp, grad_axes)
-            g_tail = lax.pmean(g_tail, grad_axes)
+        with scope("grad_reduce"):
+            g_sp = lax.pmean(g_sp, AXIS_STAGE)
+            if tile_axes:
+                g_sp = lax.pmean(g_sp, tile_axes)
+                g_tail = lax.pmean(g_tail, tile_axes)
+            if grad_axes:
+                g_sp = lax.pmean(g_sp, grad_axes)
+                g_tail = lax.pmean(g_tail, grad_axes)
 
         with scope("optimizer_update"):
             new_sp, new_opt_sp = optimizer.update(sp_buf, g_sp, opt_sp)
@@ -401,7 +403,8 @@ def _make_sp_step(
             # Spatial stats vary over stage (distinct batch chunks) and data;
             # the tile axes are already reduced inside BN (cross-tile psum) or
             # the deposit (per-tile pmean).  sp_buf is fully replicated.
-            st = lax.pmean(sp_stats, (AXIS_STAGE,) + grad_axes)
+            with scope("stats_reduce"):
+                st = lax.pmean(sp_stats, (AXIS_STAGE,) + grad_axes)
             new_sp = new_sp.at[jnp.asarray(spp.sp_stat_idx)].set(
                 st.astype(new_sp.dtype)
             )
@@ -410,10 +413,11 @@ def _make_sp_step(
             # (distinct batch shards) and over data; identical over tiles
             # under 'gather' (pmean harmless).
             stt = tail_stats
-            if tile_axes:
-                stt = lax.pmean(stt, tile_axes)
-            if grad_axes:
-                stt = lax.pmean(stt, grad_axes)
+            with scope("stats_reduce"):
+                if tile_axes:
+                    stt = lax.pmean(stt, tile_axes)
+                if grad_axes:
+                    stt = lax.pmean(stt, grad_axes)
             new_tail = scatter_stage_stats(part, new_tail, stt)
         return (
             new_sp,
@@ -556,7 +560,8 @@ def make_sp_gems_train_step(
                 from_probs=from_probs,
                 compute_dtype=compute_dtype,
             )
-        st = (stA + lax.ppermute(stB, AXIS_STAGE, mirror_perm)) / (2 * times * parts)
+        with scope("stats_mirror"):
+            st = (stA + lax.ppermute(stB, AXIS_STAGE, mirror_perm)) / (2 * times * parts)
         return loss_acc, acc_acc, st
 
     return _make_sp_step(

@@ -51,7 +51,7 @@ def _map_act(fn, x: Act) -> Act:
     return fn(x)
 
 
-def _gather_dedup(t: jax.Array, axis_name: str, dim: int, grid: int, rep: int) -> jax.Array:
+def _gather_dedup(t: jax.Array, axis_name: str, dim: int, grid: int, rep: int) -> jax.Array:  # analysis: ok(unscoped-collective) — callers own the junction/respatial scopes
     """all_gather the full extent of `dim` from a (possibly rep-duplicated)
     tile layout: device order along the axis is grid blocks of rep identical
     tiles, so the tiled gather is viewed as (grid, rep, local) and the
@@ -131,7 +131,7 @@ def can_all_to_all_junction(sp: SpatialCtx, degree: int) -> bool:
     )
 
 
-def batch_split_all_to_all(x: Act, sp: SpatialCtx,
+def batch_split_all_to_all(x: Act, sp: SpatialCtx,  # analysis: ok(unscoped-collective) — apply_junction wraps in scope("junction_batch_split_a2a")
                            h_dim: int = 1, w_dim: int = 2) -> Act:
     """Tile layout → batch-shard layout in one collective per axis.
 

@@ -71,15 +71,22 @@ def make_stage_branches(
         r0, r1 = part.ranges[s]
 
         def fn(flat_params, buf):
-            act = pk_in.unpack(lax_slice(buf, 0, pk_in.total), dtype=compute_dtype)
-            params = pkp.unpack(lax_slice(flat_params, 0, pkp.total))
-            if stat_n:
-                sink: dict = {}
-                c = dataclasses.replace(ctx, bn_sink=sink)
-            else:
-                sink, c = None, ctx
-            y = act
+            # The whole branch body rides the stage scope — the act/param
+            # unpack and the output pack/pad allocate stage-owned buffers
+            # (XLA hoists the loop-invariant parts of the tick switch out of
+            # the scan; without the scope those hoisted temps show up
+            # unattributed in the obs/hbm.py breakdown).
             with scope(f"stage{s}"):
+                act = pk_in.unpack(
+                    lax_slice(buf, 0, pk_in.total), dtype=compute_dtype
+                )
+                params = pkp.unpack(lax_slice(flat_params, 0, pkp.total))
+                if stat_n:
+                    sink: dict = {}
+                    c = dataclasses.replace(ctx, bn_sink=sink)
+                else:
+                    sink, c = None, ctx
+                y = act
                 for i in range(r0, r1):
                     with scope(f"cell{i:02d}"):
                         if cell_remat:
@@ -88,25 +95,26 @@ def make_stage_branches(
                             )
                         else:
                             y = part.model.cells[i].apply(params[i - r0], y, c)
-            out = pad_to(out_pk.pack(y, compute_dtype), part.act_max)
-            if not stat_n:
-                return out, jnp.zeros((0,), jnp.float32)
-            leaves = jax.tree.leaves(params)
-            vals = [
-                sink.get(id(leaves[i]), leaves[i]) for i in part.stat_leaf_ids[s]
-            ]
-            if vals:
-                svec = pad_to(
-                    jnp.concatenate(
-                        [jnp.ravel(v).astype(jnp.float32) for v in vals]
-                    ),
-                    stat_n,
-                )
-            else:
-                svec = jnp.zeros((stat_n,), jnp.float32)
-                if vary_axes:
-                    svec = pcast(svec, tuple(vary_axes), to="varying")
-            return out, svec
+                out = pad_to(out_pk.pack(y, compute_dtype), part.act_max)
+                if not stat_n:
+                    return out, jnp.zeros((0,), jnp.float32)
+                leaves = jax.tree.leaves(params)
+                vals = [
+                    sink.get(id(leaves[i]), leaves[i])
+                    for i in part.stat_leaf_ids[s]
+                ]
+                if vals:
+                    svec = pad_to(
+                        jnp.concatenate(
+                            [jnp.ravel(v).astype(jnp.float32) for v in vals]
+                        ),
+                        stat_n,
+                    )
+                else:
+                    svec = jnp.zeros((stat_n,), jnp.float32)
+                    if vary_axes:
+                        svec = pcast(svec, tuple(vary_axes), to="varying")
+                return out, svec
 
         return jax.checkpoint(fn) if remat else fn
 
@@ -635,21 +643,27 @@ def make_1f1b_scan(
             return (nbuf, cot, resid, gacc, gx, loss_acc, acc_acc, st_acc), None
 
         z = jnp.zeros
-        gx0 = (
-            jax.tree.map(lambda a_: v(z(a_.shape, compute_dtype)), x_parts)
-            if grad_x
-            else ()
-        )
-        init = (
-            v(z((amax,), compute_dtype)),
-            v(z((amax,), compute_dtype)),
-            v(z((D, amax), compute_dtype)),
-            v(z(flat_params.shape, flat_params.dtype)),
-            gx0,
-            v(z((), jnp.float32)),
-            v(z((), jnp.float32)),
-            v(z((stat_n,), jnp.float32)),
-        )
+        # scope: the zero ring/cotangent/accumulator inits get sunk into the
+        # per-stage dispatch conditional by XLA — name them so the obs/hbm.py
+        # breakdown attributes the ring slots instead of dropping them.
+        with scope("schedule_init"):
+            gx0 = (
+                jax.tree.map(
+                    lambda a_: v(z(a_.shape, compute_dtype)), x_parts
+                )
+                if grad_x
+                else ()
+            )
+            init = (
+                v(z((amax,), compute_dtype)),
+                v(z((amax,), compute_dtype)),
+                v(z((D, amax), compute_dtype)),
+                v(z(flat_params.shape, flat_params.dtype)),
+                gx0,
+                v(z((), jnp.float32)),
+                v(z((), jnp.float32)),
+                v(z((stat_n,), jnp.float32)),
+            )
         (_, _, _, gacc, gx, loss_acc, acc_acc, st_acc), _ = lax.scan(
             tick, init, jnp.arange(T, dtype=jnp.int32)
         )
@@ -939,21 +953,25 @@ def make_gems_1f1b_scan(
                 return (nbufA, nbufB, cotA, cotB, resA, resB,
                         gA, gB, gxA, gxB, l_acc, a_acc, stA, stB), None
 
-            gx0 = (
-                jax.tree.map(
-                    lambda a_: v(z(a_.shape[1:], compute_dtype)), xp
+            # scope: see make_1f1b_scan — zero inits sunk into the stage
+            # dispatch conditional need a name for HBM attribution.
+            with scope("schedule_init"):
+                gx0 = (
+                    jax.tree.map(
+                        lambda a_: v(z(a_.shape[1:], compute_dtype)), xp
+                    )
+                    if grad_x
+                    else ()
                 )
-                if grad_x
-                else ()
-            )
-            init = (
-                v(z((amax,), compute_dtype)), v(z((amax,), compute_dtype)),
-                v(z((amax,), compute_dtype)), v(z((amax,), compute_dtype)),
-                v(z((D, amax), compute_dtype)), v(z((D, amax), compute_dtype)),
-                gA, gB, gx0, gx0,
-                v(z((), jnp.float32)), v(z((), jnp.float32)),
-                stA_in, stB_in,
-            )
+                init = (
+                    v(z((amax,), compute_dtype)), v(z((amax,), compute_dtype)),
+                    v(z((amax,), compute_dtype)), v(z((amax,), compute_dtype)),
+                    v(z((D, amax), compute_dtype)),
+                    v(z((D, amax), compute_dtype)),
+                    gA, gB, gx0, gx0,
+                    v(z((), jnp.float32)), v(z((), jnp.float32)),
+                    stA_in, stB_in,
+                )
             (_, _, _, _, _, _, gA, gB, gxA, gxB, l_acc, a_acc, stA, stB), _ = (
                 lax.scan(tick, init, jnp.arange(T, dtype=jnp.int32))
             )

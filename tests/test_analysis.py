@@ -1005,3 +1005,109 @@ def test_shared_node_index_matches_full_walk(tmp_path):
     src = SourceFile(str(text), "m.py", text.read_text())
     walked = [n for n in ast.walk(src.tree) if isinstance(n, ast.Call)]
     assert list(src.nodes(ast.Call)) == walked
+
+
+# ---------------------------------------------------------------------------
+# (10) unscoped-collective
+# ---------------------------------------------------------------------------
+
+
+def test_unscoped_collective_positive(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        from jax import lax
+
+        def handoff(y):
+            return lax.ppermute(y, "stage", [(0, 1)])
+        """,
+        rule="unscoped-collective",
+        filename="mpi4dl_tpu/parallel/fix.py",
+    )
+    assert len(vs) == 1 and "ppermute" in vs[0].message
+
+
+def test_unscoped_collective_scoped_negative(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        from jax import lax
+        from mpi4dl_tpu.obs.scopes import scope
+
+        def handoff(y):
+            with scope("stage_handoff"):
+                return lax.ppermute(y, "stage", [(0, 1)])
+        """,
+        rule="unscoped-collective",
+        filename="mpi4dl_tpu/parallel/fix.py",
+    )
+    assert vs == []
+
+
+def test_unscoped_collective_named_scope_negative(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        import jax
+        from jax import lax
+
+        def reduce(x):
+            with jax.named_scope("loss_reduce"):
+                return lax.psum(x, "stage")
+        """,
+        rule="unscoped-collective",
+        filename="mpi4dl_tpu/ops/fix.py",
+    )
+    assert vs == []
+
+
+def test_unscoped_collective_pragma_suppresses(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        from jax import lax
+
+        def helper(y):
+            # caller owns the scope (halo_exchange_*)
+            return lax.ppermute(y, "spw", [(0, 1)])  # analysis: ok(unscoped-collective)
+        """,
+        rule="unscoped-collective",
+        filename="mpi4dl_tpu/ops/fix.py",
+    )
+    assert vs == []
+
+
+def test_unscoped_collective_outside_comm_layers_exempt(tmp_path):
+    """Only parallel/ and ops/ are in scope — train.py, models, tests and
+    benchmarks may issue collectives without scopes (their callers are the
+    engines, which own the scope vocabulary)."""
+    vs = _run(
+        tmp_path,
+        """
+        from jax import lax
+
+        def f(x):
+            return lax.pmean(x, "data")
+        """,
+        rule="unscoped-collective",
+        filename="mpi4dl_tpu/train.py",
+    )
+    assert vs == []
+
+
+def test_unscoped_collective_local_helper_not_flagged(tmp_path):
+    """A local function named like a collective is its own call site, not a
+    jax.lax collective."""
+    vs = _run(
+        tmp_path,
+        """
+        def psum(x, axis):
+            return x
+
+        def f(x):
+            return psum(x, "stage")
+        """,
+        rule="unscoped-collective",
+        filename="mpi4dl_tpu/parallel/fix.py",
+    )
+    assert vs == []
