@@ -44,6 +44,16 @@ phase group with the largest positive growth matches one of the prefixes
 (the CI gate: the O(parts) memory lives in the spatial phase + junction,
 not the tail).
 
+``--overlap`` adds the per-``obs.scope`` exposed-wire ledger (obs/overlap.py:
+which collectives ride async start/done pairs and hide under scheduled
+compute, which are sync/structurally exposed, wire-ms per scope and wire
+class) to every probed row and mirrors it as an ``overlap`` RunLog record.
+``--require-hidden-frac 0.5`` exits 1 when less than half the wire time is
+hidden on any probed row — the CI gate the T3-style halo-RDMA work
+(ROADMAP item 2) is judged by.  On the CPU backend every collective
+compiles sync, so the virtual mesh honestly reports hidden 0% — the
+baseline the overlap work must move.
+
 ``--sweep-junction`` sweeps the SP->LP junction placement (``spatial_until``)
 for the sp family and emits the placement frontier — per-placement compiled
 peak HBM plus the analytic spatial-activation ledger — as a BENCH-style JSON
@@ -83,7 +93,7 @@ def _mem_row(compiled, compile_s: float) -> dict:
     return row
 
 
-def _attribution(compiled, args, schedule=None) -> dict:
+def _attribution(compiled, args, schedule=None, hlo_text=None) -> dict:
     """The per-scope breakdown + analytical timeline of one compiled row
     (``--attribute``); printed to stderr, embedded in the JSON artifact."""
     import jax
@@ -91,7 +101,8 @@ def _attribution(compiled, args, schedule=None) -> dict:
     from mpi4dl_tpu.obs import analytical_timeline, attribute_compiled
     from mpi4dl_tpu.obs.hbm import format_breakdown
 
-    hlo_text = compiled.as_text()
+    if hlo_text is None:
+        hlo_text = compiled.as_text()
     breakdown = attribute_compiled(compiled, hlo_text=hlo_text)
     timeline = analytical_timeline(
         hlo_text, device=jax.devices()[0],
@@ -100,6 +111,23 @@ def _attribution(compiled, args, schedule=None) -> dict:
     )
     print(format_breakdown(breakdown), file=sys.stderr)
     return {"hbm": breakdown, "timeline": timeline}
+
+
+def _overlap_row(compiled, hlo_text=None) -> dict:
+    """The per-scope exposed-wire ledger of one compiled row
+    (``--overlap``); printed to stderr, embedded in the JSON artifact and
+    mirrored as an ``overlap`` RunLog record."""
+    import jax
+
+    from mpi4dl_tpu.obs import overlap_ledger
+    from mpi4dl_tpu.obs.overlap import format_ledger
+
+    ledger = overlap_ledger(
+        hlo_text if hlo_text is not None else compiled.as_text(),
+        device=jax.devices()[0],
+    )
+    print(format_ledger(ledger), file=sys.stderr)
+    return ledger
 
 
 def _probe_single(args) -> dict:
@@ -115,8 +143,14 @@ def _probe_single(args) -> dict:
         "config": vars(args),
         **_mem_row(compiled, time.perf_counter() - t0),
     }
+    # One serialization shared by both instruments: as_text() is the
+    # dominant non-compile cost on large modules.
+    hlo_text = compiled.as_text() if (args.attribute or args.overlap) \
+        else None
     if args.attribute:
-        out.update(_attribution(compiled, args))
+        out.update(_attribution(compiled, args, hlo_text=hlo_text))
+    if args.overlap:
+        out["overlap"] = _overlap_row(compiled, hlo_text)
     return out
 
 
@@ -171,8 +205,14 @@ def _probe_family(args) -> dict:
         t0 = time.perf_counter()
         compiled = step.lower(state, x, y).compile()
         rows[schedule] = _mem_row(compiled, time.perf_counter() - t0)
+        hlo_text = compiled.as_text() if (args.attribute or args.overlap) \
+            else None
         if args.attribute:
-            rows[schedule].update(_attribution(compiled, args, schedule))
+            rows[schedule].update(
+                _attribution(compiled, args, schedule, hlo_text=hlo_text)
+            )
+        if args.overlap:
+            rows[schedule]["overlap"] = _overlap_row(compiled, hlo_text)
         print(
             f"[mem_probe] {args.family}/{schedule}: "
             f"{rows[schedule]['peak_gb_est']} GB peak "
@@ -275,8 +315,12 @@ def _sweep_junction(args) -> dict:
             "spatial_ledger_mb": round(spatial_mb, 2),
             **row,
         }
+        hlo_text = compiled.as_text() if (args.attribute or args.overlap) \
+            else None
         if args.attribute:
-            entry.update(_attribution(compiled, args))
+            entry.update(_attribution(compiled, args, hlo_text=hlo_text))
+        if args.overlap:
+            entry["overlap"] = _overlap_row(compiled, hlo_text)
         placements.append(entry)
         print(
             f"[mem_probe] sweep spatial_until={su}: "
@@ -461,6 +505,17 @@ def main(argv=None) -> int:
                    help="add the per-obs.scope HBM breakdown + analytical "
                         "timeline to every probed row (obs/hbm.py, "
                         "obs/timeline.py; docs/observability.md)")
+    p.add_argument("--overlap", action="store_true",
+                   help="add the per-obs.scope exposed-wire ledger to every "
+                        "probed row (obs/overlap.py: async start/done "
+                        "windows vs sync collectives in the compiled "
+                        "schedule; docs/observability.md)")
+    p.add_argument("--require-hidden-frac", type=float, default=None,
+                   metavar="FRAC",
+                   help="with --overlap: exit 1 when less than this "
+                        "fraction of wire time is hidden under compute on "
+                        "any probed row (rows that move no collective "
+                        "bytes pass)")
     p.add_argument("--min-coverage", type=float, default=None,
                    help="with --attribute: exit 1 when less than this "
                         "fraction of peak bytes attributes to named scopes")
@@ -506,14 +561,19 @@ def main(argv=None) -> int:
               "--delta-parts/--require-delta-top need --attribute",
               file=sys.stderr)
         return 2
+    if args.require_hidden_frac is not None and not args.overlap:
+        print("[mem_probe] --require-hidden-frac needs --overlap",
+              file=sys.stderr)
+        return 2
 
     import jax
 
-    if args.attribute:
+    if args.attribute or args.overlap:
         # The persistent compilation cache keys on the program MINUS debug
         # metadata; a scope-less executable compiled elsewhere (e.g. an
         # MPI4DL_NO_SCOPES A/B run) would alias this build and return HLO
-        # text without op_name paths — attribution requires a fresh compile.
+        # text without op_name paths — attribution and the overlap ledger
+        # both require a fresh compile.
         jax.config.update("jax_compilation_cache_dir", None)
 
     # Careful not to touch jax.devices() before a mesh mode self-provisions
@@ -558,12 +618,42 @@ def main(argv=None) -> int:
                 runlog.write("hbm", label=label, breakdown=row["hbm"])
             if row.get("timeline") is not None:
                 runlog.write("timeline", label=label, **row["timeline"])
+            if row.get("overlap") is not None:
+                runlog.write("overlap", label=label, **row["overlap"])
         runlog.close()
         print(f"[mem_probe] telemetry written to {runlog.path}",
               file=sys.stderr)
     if args.attribute and (args.min_coverage is not None
                            or args.require_attrib_top):
         if _check_gates(args, gate_rows):
+            return 1
+    if args.require_hidden_frac is not None:
+        fails = 0
+        for label, row in gate_rows:
+            led = row.get("overlap")
+            if led is None:
+                continue
+            # Rows that move no collective bytes have nothing to hide.
+            hf = led.get("hidden_frac")
+            if hf is None:
+                continue
+            if hf < args.require_hidden_frac:
+                t = led["totals"]
+                print(
+                    f"[mem_probe] FAIL {label}: hidden wire fraction "
+                    f"{hf:.3f} < --require-hidden-frac "
+                    f"{args.require_hidden_frac} (exposed "
+                    f"{t['exposed_ms']} ms of {t['wire_ms']} ms wire; "
+                    f"sync collectives {t['sync']})",
+                    file=sys.stderr,
+                )
+                fails += 1
+            else:
+                print(
+                    f"[mem_probe] OK {label}: hidden wire fraction {hf:.3f}",
+                    file=sys.stderr,
+                )
+        if fails:
             return 1
     if args.require_delta_top:
         prefixes = tuple(s.strip() for s in args.require_delta_top.split(",")

@@ -123,7 +123,47 @@ def capture(args, runlog=None) -> str:
     print(f"[profile] {args.steps} steps in {dt:.2f}s "
           f"({args.steps * args.batch / dt:.2f} img/s); trace -> {args.out}",
           file=sys.stderr)
+    if runlog is not None:
+        _record_overlap(step, (state, xs[0], ys[0]), runlog)
     return args.out
+
+
+def _record_overlap(step, step_args, runlog) -> None:
+    """The analytical exposed-wire ledger of the profiled step, written as
+    an ``overlap`` RunLog record next to the measured ``xprof_ops`` table —
+    the analytical and measured views of the same step land in the same
+    JSONL for side-by-side reading (docs/observability.md).  Costs one AOT
+    compile (the jit call cache doesn't expose the compiled module's text,
+    and the persistent compilation cache is bypassed so the HLO keeps its
+    obs.scope metadata)."""
+    import time as _time
+
+    import jax
+
+    from mpi4dl_tpu.obs import overlap_ledger
+
+    t0 = _time.perf_counter()
+    try:
+        cache_dir = jax.config.jax_compilation_cache_dir
+        jax.config.update("jax_compilation_cache_dir", None)
+        try:
+            compiled = step.lower(*step_args).compile()
+        finally:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+        ledger = overlap_ledger(compiled.as_text(),
+                                device=jax.devices()[0])
+    except Exception as e:  # noqa: BLE001 — telemetry never kills a capture
+        print(f"[profile] overlap ledger unavailable ({e})", file=sys.stderr)
+        return
+    runlog.write("overlap", label="profile_step", **ledger)
+    t = ledger["totals"]
+    hf = ledger.get("hidden_frac")
+    print(
+        f"[profile] overlap ledger ({_time.perf_counter() - t0:.1f}s AOT "
+        f"compile): wire {t['wire_ms']} ms, exposed {t['exposed_ms']} ms"
+        + (f" (hidden {hf:.1%})" if hf is not None else ""),
+        file=sys.stderr,
+    )
 
 
 def _find_xplane(trace_dir: str) -> str | None:

@@ -55,9 +55,11 @@ def main(argv=None) -> int:
                         "group by default — the high-resolution cells)")
     p.add_argument("--attribute", action="store_true",
                    help="add the per-obs.scope HBM breakdown + analytical "
-                        "timeline (obs/hbm.py, obs/timeline.py) to the "
-                        "artifact — names which phase owns the per-device "
-                        "GB this tool reports")
+                        "timeline + exposed-wire overlap ledger (obs/hbm.py,"
+                        " obs/timeline.py, obs/overlap.py) to the artifact "
+                        "— names which phase owns the per-device GB this "
+                        "tool reports and how much of the per-step wire "
+                        "volume is structurally hidden vs exposed")
     p.add_argument("--telemetry-dir", default=None,
                    help="mirror the artifact into a RunLog JSONL "
                         "(readiness + hbm + timeline records; render with "
@@ -191,23 +193,52 @@ def main(argv=None) -> int:
             ),
         },
     }
-    breakdown = timeline = None
+    breakdown = timeline = ledger = None
     if args.attribute:
-        from mpi4dl_tpu.obs import analytical_timeline, attribute_compiled
+        from mpi4dl_tpu.obs import (
+            analytical_timeline,
+            attribute_compiled,
+            overlap_ledger,
+        )
         from mpi4dl_tpu.obs.hbm import format_breakdown, scope_group_bytes
+        from mpi4dl_tpu.obs.overlap import format_ledger
 
         breakdown = attribute_compiled(compiled, hlo_text=hlo_text)
         timeline = analytical_timeline(
             hlo_text, device=jax.devices()[0],
             schedule=args.schedule, stages=S, parts=args.parts,
         )
+        ledger = overlap_ledger(hlo_text, device=jax.devices()[0])
         out["hbm"] = breakdown
         out["timeline"] = timeline
+        out["overlap"] = ledger
         out["hbm_phase_groups_gb"] = {
             k: round(v / 2**30, 3)
             for k, v in scope_group_bytes(breakdown).items()
         }
+        # The overlap rollup: how much of the wire volume this tool reports
+        # under "what moves per step" is structurally hidden vs exposed in
+        # the compiled schedule (ROADMAP item 2's measurement half; on the
+        # CPU backend every collective compiles sync, so exposed == all —
+        # the baseline the halo-RDMA overlap work must move).
+        t_led = ledger["totals"]
+        out["overlap_rollup"] = {
+            "wire_gb": round(t_led["bytes"] / 2**30, 3),
+            "exposed_ms": t_led["exposed_ms"],
+            "hidden_ms": t_led["hidden_ms"],
+            "hidden_frac": ledger["hidden_frac"],
+            "async_pairs": t_led["async_pairs"],
+            "sync_collectives": t_led["sync"],
+            "attributed_bytes_frac": ledger["attributed_bytes_frac"],
+            "by_class": {
+                cls: {"exposed_ms": b["exposed_ms"],
+                      "hidden_ms": b["hidden_ms"],
+                      "gb": round(b["bytes"] / 2**30, 3)}
+                for cls, b in ledger["by_class"].items()
+            },
+        }
         print(format_breakdown(breakdown), file=sys.stderr)
+        print(format_ledger(ledger), file=sys.stderr)
 
     line = json.dumps(out)
     print(line)
@@ -221,10 +252,12 @@ def main(argv=None) -> int:
         runlog.write_meta(config=out["config"], family="sp",
                           argv=list(argv) if argv is not None else sys.argv[1:])
         runlog.write("readiness", **{k: v for k, v in out.items()
-                                     if k not in ("hbm", "timeline")})
+                                     if k not in ("hbm", "timeline",
+                                                  "overlap")})
         if breakdown is not None:
             runlog.write("hbm", label="readiness", breakdown=breakdown)
             runlog.write("timeline", label="readiness", **timeline)
+            runlog.write("overlap", label="readiness", **ledger)
         runlog.close()
         print(f"[readiness] telemetry written to {runlog.path}",
               file=sys.stderr)

@@ -13,7 +13,12 @@ structured **contract** is extracted from the lowered StableHLO + jaxpr:
 - the scope-coverage set (instrumentation that silently disappears drifts);
 - trace/lowering counts during build+lower (the retrace budget);
 - GSPMD sharding annotations and entry shapes (a resharding inserted at a
-  junction shows here before any benchmark regresses).
+  junction shows here before any benchmark regresses);
+- the **overlap structure** of the compiled scheduled HLO (schema 2,
+  obs/overlap.py): per-scope per-class async start/done-pair counts, sync
+  (unsplit, structurally unhideable) counts, payload bytes and structurally
+  exposed bytes — a collective that loses its async split fails the gate
+  with the owning scope named (ISSUE 9, ROADMAP item 2).
 
 Contracts are checked into ``contracts/<engine>.json`` as goldens;
 ``python -m mpi4dl_tpu.analysis contracts`` re-extracts and diffs, exiting

@@ -56,6 +56,11 @@ def main(argv=None) -> int:
     ap.add_argument("--engines", metavar="NAMES", default=None,
                     help="comma-separated subset of engine families "
                          f"(default: {','.join(ENGINE_FAMILIES)})")
+    ap.add_argument("--section", choices=["overlap"], default=None,
+                    help="restrict drift reporting to one contract section "
+                         "(plus meta mismatches); the overlap-contract CI "
+                         "job gates on --section overlap so overlap "
+                         "regressions fail with a focused report")
     args = ap.parse_args(argv)
 
     families = list(ENGINE_FAMILIES)
@@ -98,6 +103,9 @@ def main(argv=None) -> int:
         with open(path, "r", encoding="utf-8") as fh:
             golden = json.load(fh)
         drifts = diff_contracts(golden, current)
+        if args.section:
+            drifts = [d for d in drifts
+                      if d["kind"] in ("meta", args.section)]
         report[family] = drifts
         if drifts:
             rc = 1

@@ -13,6 +13,10 @@ machine-checkable (the CI job uploads it as an artifact on failure):
 - ``lowerings``: trace/lowering count moved (retrace budget);
 - ``sharding``: a GSPMD sharding annotation histogram entry or an entry
   shape changed;
+- ``overlap``: the compiled schedule's overlap structure moved for a
+  (scope, collective class) — async-pair/sync counts, payload bytes, or
+  structurally exposed bytes (a collective that loses its start/done split
+  becomes unhideable; ISSUE 9 / ROADMAP item 2);
 - ``meta``: schema/engine mismatch (golden unusable — regenerate).
 """
 
@@ -109,7 +113,35 @@ def diff_contracts(golden: dict, current: dict) -> List[dict]:
             "kind": "sharding", "annotation": "<entry shapes>",
             "golden": g_in, "current": c_in,
         })
+
+    drifts += _diff_overlap(
+        _counted(golden, "overlap", "per_scope"),
+        _counted(current, "overlap", "per_scope"),
+    )
     return drifts
+
+
+_OVERLAP_FIELDS = ("async_pairs", "sync", "bytes", "exposed_bytes")
+_OVERLAP_ZERO = {f: 0 for f in _OVERLAP_FIELDS}
+
+
+def _diff_overlap(golden: dict, current: dict) -> List[dict]:
+    """Diff two overlap ``per_scope`` trees ({scope: {class: {async_pairs,
+    sync, bytes, exposed_bytes}}}) into per-(scope, class) drift records."""
+    out: List[dict] = []
+    for scope in sorted(set(golden) | set(current)):
+        g_ops, c_ops = golden.get(scope, {}), current.get(scope, {})
+        for op in sorted(set(g_ops) | set(c_ops)):
+            g = {**_OVERLAP_ZERO, **g_ops.get(op, {})}
+            c = {**_OVERLAP_ZERO, **c_ops.get(op, {})}
+            if g == c:
+                continue
+            rec = {"kind": "overlap", "scope": scope, "op": op}
+            for f in _OVERLAP_FIELDS:
+                rec[f"{f}_golden"] = g[f]
+                rec[f"{f}_current"] = c[f]
+            out.append(rec)
+    return out
 
 
 def _fmt_delta(golden: int, current: int) -> str:
@@ -151,6 +183,21 @@ def render_drift_report(engine: str, drifts: List[dict]) -> str:
             lines.append(
                 f"  lowerings.{d['field']}: "
                 f"{_fmt_delta(d['golden'], d['current'])} (retrace budget)"
+            )
+        elif kind == "overlap":
+            bits = []
+            for f in _OVERLAP_FIELDS:
+                g_v, c_v = d[f"{f}_golden"], d[f"{f}_current"]
+                if g_v != c_v:
+                    bits.append(f"{f} {_fmt_delta(g_v, c_v)}")
+            extra = ""
+            if (d["sync_golden"] == 0 and d["sync_current"] > 0
+                    and d["async_pairs_current"] < d["async_pairs_golden"]):
+                extra = " — collective LOST its start/done split " \
+                        "(now structurally unhideable)"
+            lines.append(
+                f"  overlap scope {d['scope']}: {d['op']} "
+                + ", ".join(bits) + extra
             )
         elif kind == "sharding":
             if "count_golden" in d:

@@ -1,16 +1,25 @@
-"""Contract extraction: lowered StableHLO + jaxpr -> structured contract.
+"""Contract extraction: lowered StableHLO + jaxpr + scheduled HLO ->
+structured contract.
 
-Everything here is compile-time only: the engine's train step is built and
-``.lower()``-ed on the virtual mesh, never compiled or executed, so the gate
-runs on any CPU host in tens of seconds — the same property that makes the
-source analyzer usable without a TPU tunnel window.
+The engine's train step is built, ``.lower()``-ed and (since schema 2)
+``compile()``-d on the virtual mesh — never executed — so the gate runs on
+any CPU host in tens of seconds, the same property that makes the source
+analyzer usable without a TPU tunnel window.  The compile feeds the
+``overlap`` section: the *scheduled* compiled HLO is the only artifact that
+says whether a collective was split into async start/done halves (hideable)
+or compiled sync (structurally unhideable) — obs/overlap.py's structural
+projection, pinned per scope (ISSUE 9, ROADMAP item 2's overlap-structure
+gate).
 """
 
 from __future__ import annotations
 
 from typing import Dict, List, Optional
 
-CONTRACT_SCHEMA = 1
+# Schema 2: adds the ``overlap`` section (per-scope per-class async-pair/
+# sync counts, payload bytes, structurally exposed bytes from the compiled
+# scheduled HLO).  Goldens with schema 1 are unusable — regenerate.
+CONTRACT_SCHEMA = 2
 
 # jaxpr collective primitives -> the mesh-axis parameter that names them.
 _JAXPR_COLLECTIVES = ("psum", "pmax", "pmin", "ppermute", "all_gather",
@@ -172,7 +181,32 @@ def extract_contract(family: str, build=None) -> dict:
                 jax.tree_util.tree_leaves(lowered.in_avals)
             ),
         },
+        "overlap": _overlap_section(lowered),
     }
+
+
+def _overlap_section(lowered) -> dict:
+    """The compiled scheduled HLO's structural overlap projection
+    (obs/overlap.py): which collectives ride async start/done pairs vs
+    sync ops, per scope, with payload and structurally-exposed bytes —
+    a collective compiled *without* a start/done split can never hide
+    under compute, so a sync count that grows is an overlap regression no
+    benchmark has to measure first.  The compile bypasses the persistent
+    compilation cache — it keys on the program minus debug metadata, so a
+    scope-less executable compiled elsewhere could alias this build and
+    hand back HLO without op_name paths (the obs/hbm.py attribution caveat
+    applies here verbatim)."""
+    import jax
+
+    from mpi4dl_tpu.obs.overlap import structural_overlap
+
+    cache_dir = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    try:
+        compiled = lowered.compile()
+    finally:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    return structural_overlap(compiled.as_text())
 
 
 def _sorted_nested(d: dict) -> dict:

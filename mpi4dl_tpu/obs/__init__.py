@@ -52,9 +52,16 @@ from mpi4dl_tpu.obs.hbm import (
 from mpi4dl_tpu.obs.timeline import (
     analytical_timeline,
     bubble_fraction,
+    collective_base,
     format_timeline,
     hlo_scope_costs,
     pipeline_ticks,
+)
+from mpi4dl_tpu.obs.overlap import (
+    format_ledger,
+    overlap_ledger,
+    structural_overlap,
+    wire_class,
 )
 from mpi4dl_tpu.obs.hlo_stats import (
     clean_scope_path,
@@ -76,12 +83,14 @@ __all__ = [
     "attribute_hlo",
     "bubble_fraction",
     "clean_scope_path",
+    "collective_base",
     "compare_breakdowns",
     "compiled_collective_stats",
     "compiled_cost",
     "device_memory_watermark",
     "format_breakdown",
     "format_delta",
+    "format_ledger",
     "format_timeline",
     "hlo_collective_stats",
     "hlo_scope_costs",
@@ -89,6 +98,7 @@ __all__ = [
     "ici_bytes_per_s",
     "jit_cache_size",
     "mfu",
+    "overlap_ledger",
     "peak_flops",
     "pipeline_ticks",
     "read_runlog",
@@ -102,5 +112,7 @@ __all__ = [
     "stablehlo_sharding_annotations",
     "step_annotation",
     "step_cost",
+    "structural_overlap",
     "top_scope",
+    "wire_class",
 ]

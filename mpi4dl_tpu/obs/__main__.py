@@ -1,15 +1,21 @@
-"""CLI: ``python -m mpi4dl_tpu.obs report run.jsonl [more.jsonl ...]``
-and ``... report --compare A.jsonl B.jsonl [--threshold PCT]``.
+"""CLI: ``python -m mpi4dl_tpu.obs report run.jsonl [more.jsonl ...]``,
+``... report --compare A.jsonl B.jsonl [--threshold PCT]``, and
+``... overlap --families lp,sp|all [--json] [--out F]``.
 
-Renders the summary table of one or more RunLog files, or the per-metric
-regression diff of two (docs/observability.md documents every field and the
-compare metrics).  Exit status: 0 on success, 1 when --compare finds a
-regression past the threshold, 2 on usage errors or unreadable files.
+``report`` renders the summary table of one or more RunLog files, or the
+per-metric regression diff of two (docs/observability.md documents every
+field and the compare metrics).  ``overlap`` builds + compiles engine
+families on the virtual mesh (or reads an HLO text dump via ``--hlo``) and
+prints their exposed-wire ledgers (obs/overlap.py) — the CI
+``overlap-contract`` job's ledger artifact.  Exit status: 0 on success, 1
+when --compare finds a regression past the threshold, 2 on usage errors or
+unreadable files.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 from typing import List, Optional
 
@@ -29,13 +35,34 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--compare", nargs=2, metavar=("A", "B"), default=None,
         help="per-metric regression diff (A = baseline, B = candidate): "
              "step ms, images/sec, peak HBM, collective bytes, mem_probe "
-             "peak; exit 1 when a metric regresses past --threshold",
+             "peak, exposed wire ms; exit 1 when a metric regresses past "
+             "--threshold",
     )
     rep.add_argument(
         "--threshold", type=float, default=5.0,
         help="regression threshold in percent for --compare (default 5)",
     )
+    ovl = sub.add_parser(
+        "overlap",
+        help="exposed-wire ledger of engine families (compiled on the "
+             "virtual mesh) or of an HLO text dump",
+    )
+    ovl.add_argument(
+        "--families", default=None,
+        help="comma-separated engine families to compile and ledger "
+             "('all' = every contract family)",
+    )
+    ovl.add_argument("--hlo", default=None, metavar="F",
+                     help="ledger an existing compiled-HLO text dump "
+                          "instead of building engines")
+    ovl.add_argument("--json", action="store_true",
+                     help="machine-readable ledgers on stdout")
+    ovl.add_argument("--out", default=None, metavar="F",
+                     help="also write the JSON ledgers to this file")
     args = ap.parse_args(argv)
+
+    if args.cmd == "overlap":
+        return _overlap_cmd(args)
 
     if args.cmd == "report":
         if args.compare and args.paths:
@@ -72,6 +99,79 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(text)
         return 0
     return 2  # pragma: no cover — argparse enforces the subcommand
+
+
+def _overlap_cmd(args) -> int:
+    """``obs overlap``: per-family (or per-HLO-dump) exposed-wire ledgers."""
+    from mpi4dl_tpu.obs.overlap import format_ledger, overlap_ledger
+
+    if bool(args.hlo) == bool(args.families):
+        print("obs overlap: need exactly one of --families or --hlo",
+              file=sys.stderr)
+        return 2
+
+    ledgers = {}
+    if args.hlo:
+        try:
+            with open(args.hlo, "r", encoding="utf-8") as fh:
+                text = fh.read()
+        except OSError as e:
+            print(f"obs overlap: cannot read {args.hlo}: {e}",
+                  file=sys.stderr)
+            return 2
+        # Same cost rates as the --families branch (device-derived, nominal
+        # on CPU hosts): without a peak the compute windows would cost 0 ms
+        # and every async pair would read as fully exposed.
+        import jax
+
+        ledgers[args.hlo] = overlap_ledger(text, device=jax.devices()[0])
+    else:
+        from mpi4dl_tpu.analysis.contracts.engines import (
+            ENGINE_FAMILIES,
+            build_engine,
+        )
+        from mpi4dl_tpu.analysis.contracts.extract import ensure_virtual_mesh
+
+        families = (
+            list(ENGINE_FAMILIES) if args.families == "all"
+            else [f.strip() for f in args.families.split(",") if f.strip()]
+        )
+        unknown = [f for f in families if f not in ENGINE_FAMILIES]
+        if unknown:
+            print(f"obs overlap: unknown engine(s) {unknown}; "
+                  f"have {list(ENGINE_FAMILIES)}", file=sys.stderr)
+            return 2
+        err = ensure_virtual_mesh(families)
+        if err:
+            print(f"obs overlap: {err}", file=sys.stderr)
+            return 2
+        import jax
+
+        # Bypass the persistent compilation cache: it keys on the program
+        # minus debug metadata, and the ledger needs the op_name scopes
+        # (the obs/hbm.py attribution caveat).
+        jax.config.update("jax_compilation_cache_dir", None)
+        for family in families:
+            step, fargs = build_engine(family)
+            compiled = step.lower(*fargs).compile()
+            ledgers[family] = overlap_ledger(compiled.as_text(),
+                                             device=jax.devices()[0])
+
+    payload = json.dumps(ledgers, indent=1, sort_keys=True)
+    # Write the artifact before stdout: a consumer truncating the pipe
+    # (e.g. `| head`) must not cost the CI job its ledger file.
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(payload + "\n")
+    if args.json:
+        print(payload)
+    else:
+        for i, (name, ledger) in enumerate(ledgers.items()):
+            if i:
+                print()
+            print(f"== {name}")
+            print(format_ledger(ledger))
+    return 0
 
 
 if __name__ == "__main__":

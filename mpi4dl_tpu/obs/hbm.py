@@ -97,6 +97,9 @@ class Instr:
     callees: Tuple[str, ...]
     op_name: str  # raw metadata op_name ("" when absent)
     scope: str    # clean_scope_path(op_name)
+    raw: str = ""  # the full instruction line (attribute strings the fields
+    #              above do not keep: window/dim_labels/contracting dims —
+    #              obs/timeline.py's FLOP model reads them from here)
 
     @property
     def is_view(self) -> bool:
@@ -157,6 +160,7 @@ def _parse_instruction(line: str) -> Optional[Instr]:
         name=name, shape=shape, opcode=opcode, bytes=shape_bytes(shape),
         operands=operands, callees=tuple(callees), op_name=op_name,
         scope=clean_scope_path(op_name) if "/" in op_name else "",
+        raw=line,
     )
 
 

@@ -1,0 +1,527 @@
+"""Exposed-wire ledger from the scheduled compiled HLO.
+
+obs/timeline.py brackets a step between two extremes — serialized (no
+compute/collective overlap) and perfect overlap — but the compiled module
+already says where between them the schedule actually lands: the HLO is
+**scheduled** (instruction order per computation is execution order), and a
+collective XLA intends to hide is split into ``*-start``/``*-done`` async
+halves with the hiding compute scheduled *between* them.  This module walks
+that schedule and produces the **overlap ledger**:
+
+- for every async collective pair, the **overlap window** — the compute
+  instructions (FLOP-time from obs/timeline.py's cost model) scheduled
+  between start and done.  Wire time covered by the window is **hidden**;
+  the remainder is **exposed** (the device stalls at the done);
+- a collective compiled *without* a start/done split is **sync** —
+  structurally unhideable, its full wire time exposed no matter what the
+  cost model says.  (The CPU backend compiles every collective sync, so on
+  the virtual mesh the ledger reports 100% exposed — which is the honest
+  baseline measurement ROADMAP item 2's halo-RDMA work must beat);
+- everything attributed to ``obs.scope`` via the contract gate's
+  :func:`clean_scope_path`, rolled up per scope and per semantic wire class
+  (halo / junction / respatial / pipeline handoff / grad+stats reduce).
+
+The simulation model (documented limits, hand-computed cases in
+tests/test_overlap.py):
+
+- compute time = conv/dot FLOPs over the bf16 peak (element-wise and
+  memory-bound work costs zero — same caveat as the analytical timeline);
+- one shared wire: in-flight transfers serialize among themselves
+  (``wire_free`` clock), so a done can stall on queueing behind an earlier
+  transfer as well as on its own payload; that queueing delay counts as
+  exposed;
+- each computation simulates with its own local clock; call-like ops
+  (while/conditional/call) contribute their callee bodies ONCE at the call
+  site (trip counts are not folded in — the structural per-step convention
+  the whole analytic stack uses), and fusion bodies contribute their FLOPs;
+- start/done pairs match within one computation (HLO guarantees this); a
+  start whose done never appears is closed at the end of its computation.
+
+:func:`overlap_ledger` is the time-domain product (ms, fractions — the
+``overlap`` RunLog record, ``mem_probe --overlap``, the readiness rollup).
+:func:`structural_overlap` is the integer-only projection the contract gate
+pins as a golden: per-scope async-pair/sync counts, payload bytes, and
+**structurally exposed bytes** (sync payloads plus async pairs whose window
+contains zero FLOPs — no cost model, no floats, stable under a pinned jax).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from mpi4dl_tpu.obs.costs import (
+    DEFAULT_ICI_BYTES_PER_S,
+    ici_bytes_per_s,
+    peak_flops,
+)
+from mpi4dl_tpu.obs.hbm import Instr, parse_hlo_module, shape_bytes
+from mpi4dl_tpu.obs.timeline import (
+    ASYNC_GLUE_OPS,
+    collective_base,
+    instr_flops,
+)
+
+UNSCOPED = "<unscoped>"
+
+_CALL_OPS = ("while", "conditional", "call")
+
+
+def _tuple_elements(shape: str) -> List[str]:
+    """Top-level elements of an HLO tuple shape literal (depth-1 commas);
+    a non-tuple shape is its own single element."""
+    shape = shape.strip()
+    if not shape.startswith("("):
+        return [shape]
+    inner = shape[1:-1] if shape.endswith(")") else shape[1:]
+    out, depth, cur = [], 0, []
+    for ch in inner:
+        if ch in "([{":
+            depth += 1
+        elif ch in ")]}":
+            depth -= 1
+        if ch == "," and depth == 0:
+            out.append("".join(cur).strip())
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        out.append("".join(cur).strip())
+    return out
+
+
+def start_payload_bytes(ins: Instr) -> int:
+    """Wire payload of an async ``*-start``: the RESULT element of the
+    start tuple ``(operand, result[, contexts])`` — matching
+    ``hlo_collective_stats`` so sync and async forms of the same program
+    report identical bytes.  Falls back to the full shape."""
+    elems = _tuple_elements(ins.shape)
+    if len(elems) > 1:
+        return shape_bytes(elems[1])
+    return ins.bytes
+
+
+@dataclasses.dataclass
+class WireEvent:
+    """One collective's wire accounting in the simulated schedule."""
+    scope: str          # clean obs.scope path ("" = unscoped)
+    cls: str            # HLO base opcode (collective-permute, all-gather…)
+    bytes: int          # wire payload
+    wire_ms: float      # bytes / ICI bandwidth
+    hidden_ms: float    # wire time covered by compute in the window
+    exposed_ms: float   # stall at the done (includes wire-queueing delay)
+    sync: bool          # compiled without a start/done split
+    window_flops: float  # FLOPs scheduled inside the start..done window
+    comp: str           # computation the collective was scheduled in
+
+
+@dataclasses.dataclass
+class _CompSim:
+    duration_ms: float
+    flops: float
+    events: List[WireEvent]
+
+
+@dataclasses.dataclass
+class _Pending:
+    issue_ms: float
+    flops_at_issue: float
+    bytes: int
+    cls: str
+    scope: str
+
+
+class _ScheduleWalker:
+    """Per-computation schedule simulation with memoization (a computation
+    called from two sites contributes its body once per call site, computed
+    once)."""
+
+    def __init__(self, comps: Dict[str, List[Instr]],
+                 peak: Optional[float], ici_bw: Optional[float]):
+        self.comps = comps
+        self.peak = peak or 0.0
+        self.ici_bw = ici_bw or 0.0
+        self._sim_cache: Dict[str, _CompSim] = {}
+        self._flops_cache: Dict[str, float] = {}
+
+    # -- cost primitives ---------------------------------------------------
+
+    def _wire_ms(self, nbytes: int) -> float:
+        return nbytes / self.ici_bw * 1e3 if self.ici_bw else 0.0
+
+    def _compute_ms(self, flops: float) -> float:
+        return flops / self.peak * 1e3 if self.peak else 0.0
+
+    def comp_flops(self, comp: str) -> float:
+        """Total conv/dot FLOPs of a computation including nested callees
+        (fusion bodies carry the conv metadata)."""
+        if comp in self._flops_cache:
+            return self._flops_cache[comp]
+        self._flops_cache[comp] = 0.0  # cycle guard
+        total = 0.0
+        for ins in self.comps.get(comp, ()):
+            if ins.opcode in ("convolution", "dot"):
+                total += instr_flops(ins, ins.raw)
+            for callee in ins.callees:
+                total += self.comp_flops(callee)
+        self._flops_cache[comp] = total
+        return total
+
+    # -- async bookkeeping -------------------------------------------------
+
+    def _wrapped_collective(self, ins: Instr) -> Optional[Instr]:
+        """The collective op inside a generic ``async-start``'s wrapped
+        computation, if any."""
+        for callee in ins.callees:
+            for sub in self.comps.get(callee, ()):
+                if collective_base(sub.opcode):
+                    return sub
+        return None
+
+    def _resolve_start(self, name: str, by_name: Dict[str, Instr],
+                       pending: Dict[str, _Pending],
+                       seen: Optional[Set[str]] = None) -> Optional[str]:
+        """Follow a done's operand chain (through ``async-update`` and
+        views) back to a pending start's name."""
+        if name in pending:
+            return name
+        if seen is None:
+            seen = set()
+        if name in seen:
+            return None
+        seen.add(name)
+        ins = by_name.get(name)
+        if ins is None:
+            return None
+        if ins.opcode in ASYNC_GLUE_OPS or ins.is_view:
+            for op in ins.operands:
+                found = self._resolve_start(op, by_name, pending, seen)
+                if found:
+                    return found
+        return None
+
+    # -- the walk ----------------------------------------------------------
+
+    def sim(self, comp: str) -> _CompSim:
+        if comp in self._sim_cache:
+            return self._sim_cache[comp]
+        self._sim_cache[comp] = _CompSim(0.0, 0.0, [])  # cycle guard
+        result = self._sim_uncached(comp)
+        self._sim_cache[comp] = result
+        return result
+
+    def _sim_uncached(self, comp: str) -> _CompSim:
+        instrs = self.comps.get(comp, [])
+        by_name = {i.name: i for i in instrs}
+        clock = 0.0        # device timeline (compute + stalls)
+        wire_free = 0.0    # when the shared wire finishes its current queue
+        flops_acc = 0.0
+        events: List[WireEvent] = []
+        pending: Dict[str, _Pending] = {}
+
+        def finish(p: _Pending, now: float) -> Tuple[float, float, float]:
+            """(wire_ms, hidden_ms, exposed_ms) of a pending transfer whose
+            done executes at device time ``now``; advances the wire clock."""
+            nonlocal wire_free
+            wire_ms = self._wire_ms(p.bytes)
+            begin = max(p.issue_ms, wire_free)
+            end = begin + wire_ms
+            wire_free = end
+            exposed = max(0.0, end - now)          # stall incl. queueing
+            hidden = max(0.0, wire_ms - exposed)   # covered by the window
+            return wire_ms, hidden, exposed
+
+        for ins in instrs:
+            base = collective_base(ins.opcode)
+            if ins.opcode.endswith("-start") and (
+                base or ins.opcode == "async-start"
+            ):
+                cls, scope, nbytes = base, ins.scope, start_payload_bytes(ins)
+                if ins.opcode == "async-start":
+                    inner = self._wrapped_collective(ins)
+                    if inner is None:
+                        continue  # copy-start etc.: not wire traffic
+                    cls = collective_base(inner.opcode)
+                    scope = ins.scope or inner.scope
+                    nbytes = (start_payload_bytes(inner)
+                              if inner.opcode.endswith("-start")
+                              else inner.bytes)
+                pending[ins.name] = _Pending(clock, flops_acc, nbytes,
+                                             cls or "collective", scope)
+            elif ins.opcode.endswith("-done") and (
+                base or ins.opcode == "async-done"
+            ):
+                start = self._resolve_start(
+                    ins.operands[0], by_name, pending
+                ) if ins.operands else None
+                if start is None:
+                    continue
+                p = pending.pop(start)
+                wire_ms, hidden, exposed = finish(p, clock)
+                clock += exposed
+                events.append(WireEvent(
+                    scope=p.scope, cls=p.cls, bytes=p.bytes,
+                    wire_ms=wire_ms, hidden_ms=hidden, exposed_ms=exposed,
+                    sync=False, window_flops=flops_acc - p.flops_at_issue,
+                    comp=comp,
+                ))
+            elif base:
+                # Sync collective: no split, the device sits on the whole
+                # transfer — structurally unhideable.
+                wire_ms = self._wire_ms(ins.bytes)
+                begin = max(clock, wire_free)
+                wire_free = begin + wire_ms
+                stall = wire_free - clock
+                clock = wire_free
+                events.append(WireEvent(
+                    scope=ins.scope, cls=base, bytes=ins.bytes,
+                    wire_ms=wire_ms, hidden_ms=0.0, exposed_ms=stall,
+                    sync=True, window_flops=0.0, comp=comp,
+                ))
+            elif ins.opcode in ("convolution", "dot"):
+                fl = instr_flops(ins, ins.raw)
+                flops_acc += fl
+                clock += self._compute_ms(fl)
+            elif ins.opcode == "fusion":
+                fl = sum(self.comp_flops(c) for c in ins.callees)
+                flops_acc += fl
+                clock += self._compute_ms(fl)
+            elif ins.callees and ins.opcode in _CALL_OPS:
+                # Body contributes once at the call site (structural, trip
+                # counts not folded); conditional branches sum — the same
+                # all-computations-once convention as hlo_scope_costs.
+                for callee in ins.callees:
+                    sub = self.sim(callee)
+                    clock += sub.duration_ms
+                    flops_acc += sub.flops
+                    events.extend(sub.events)
+            elif ins.callees and ins.opcode not in ASYNC_GLUE_OPS:
+                # reduce/sort/map bodies: FLOPs only (no collectives there).
+                # Async glue is excluded: an async-update's wrapped
+                # computation belongs to its start/done pair, not to the
+                # caller's compute time.
+                fl = sum(self.comp_flops(c) for c in ins.callees)
+                flops_acc += fl
+                clock += self._compute_ms(fl)
+
+        # Starts whose done never appeared: close them at the end of the
+        # computation (the value must be ready before the computation ends).
+        for name, p in pending.items():
+            wire_ms, hidden, exposed = finish(p, clock)
+            clock += exposed
+            events.append(WireEvent(
+                scope=p.scope, cls=p.cls, bytes=p.bytes, wire_ms=wire_ms,
+                hidden_ms=hidden, exposed_ms=exposed, sync=False,
+                window_flops=flops_acc - p.flops_at_issue, comp=comp,
+            ))
+        return _CompSim(duration_ms=clock, flops=flops_acc, events=events)
+
+
+def wire_class(scope: str, cls: str) -> str:
+    """Semantic wire class of a collective from its obs.scope vocabulary —
+    the per-class rollup PERF_NOTES' "what moves per step" table uses
+    (halo ppermutes / junction gathers / respatial / pipeline handoffs /
+    grad+stats reduces); falls back to the HLO opcode class."""
+    s = scope or ""
+    if "halo" in s or "d2_run" in s or "ring_step_hop" in s:
+        return "halo"
+    if "junction" in s or "stage_lineup" in s:
+        return "junction"
+    if "respatial" in s:
+        return "respatial"
+    if "handoff" in s or "mb_inject" in s or "mirror" in s:
+        return "pipeline_handoff"
+    if ("grad_reduce" in s or "loss_reduce" in s or "stats" in s
+            or "bn_" in s or "optimizer" in s):
+        return "grad_stats_reduce"
+    return cls
+
+
+def _events(hlo_text: str, peak: Optional[float], ici_bw: Optional[float]
+            ) -> Tuple[List[WireEvent], _CompSim]:
+    comps, entry = parse_hlo_module(hlo_text)
+    if not entry:
+        raise ValueError("no ENTRY computation found in HLO text")
+    walker = _ScheduleWalker(comps, peak, ici_bw)
+    sim = walker.sim(entry)
+    return sim.events, sim
+
+
+def overlap_ledger(
+    hlo_text: str,
+    *,
+    peak: Optional[float] = None,
+    ici_bw: Optional[float] = None,
+    device=None,
+    top: int = 24,
+) -> dict:
+    """The per-scope exposed/hidden wire ledger of one compiled module.
+
+    ``peak``/``ici_bw`` default from ``device`` exactly like
+    :func:`~mpi4dl_tpu.obs.timeline.analytical_timeline` (CPU hosts get the
+    labeled nominal constants).  Returns a JSON-ready dict (the ``overlap``
+    RunLog record; render with :func:`format_ledger`)::
+
+        rows                per-scope {bytes, wire_ms, hidden_ms,
+                            exposed_ms, async_pairs, sync, classes}
+                            sorted by exposed_ms
+        by_class            the same, rolled up by semantic wire class
+        totals              step-level sums + async_pairs/sync counts
+        hidden_frac         hidden / wire (None when nothing moves)
+        attributed_bytes_frac  collective bytes landing in named scopes
+        simulated_step_ms   the schedule-aware wall estimate (compute +
+                            exposed wire) that replaces the coarse
+                            serialized/perfect-overlap brackets
+    """
+    peak_src = ici_src = "given"
+    if peak is None:
+        peak, peak_src = peak_flops(device, allow_cpu_nominal=True) \
+            if device is not None else (None, None)
+    if ici_bw is None:
+        if device is not None:
+            ici_bw, ici_src = ici_bytes_per_s(device)
+        else:
+            ici_bw, ici_src = DEFAULT_ICI_BYTES_PER_S, "default"
+
+    events, sim = _events(hlo_text, peak, ici_bw)
+
+    def bucket() -> dict:
+        return {"bytes": 0, "wire_ms": 0.0, "hidden_ms": 0.0,
+                "exposed_ms": 0.0, "async_pairs": 0, "sync": 0}
+
+    def add(b: dict, e: WireEvent) -> None:
+        b["bytes"] += e.bytes
+        b["wire_ms"] += e.wire_ms
+        b["hidden_ms"] += e.hidden_ms
+        b["exposed_ms"] += e.exposed_ms
+        b["async_pairs"] += 0 if e.sync else 1
+        b["sync"] += 1 if e.sync else 0
+
+    by_scope: Dict[str, dict] = {}
+    by_class: Dict[str, dict] = {}
+    totals = bucket()
+    attributed = 0
+    for e in events:
+        key = e.scope or UNSCOPED
+        row = by_scope.setdefault(key, {**bucket(), "classes": {}})
+        add(row, e)
+        add(row["classes"].setdefault(e.cls, bucket()), e)
+        add(by_class.setdefault(wire_class(e.scope, e.cls), bucket()), e)
+        add(totals, e)
+        if e.scope:
+            attributed += e.bytes
+
+    def rounded(d: dict) -> dict:
+        return {k: (round(v, 4) if isinstance(v, float) else v)
+                for k, v in d.items()}
+
+    rows = [
+        {"scope": k, **rounded({kk: vv for kk, vv in v.items()
+                                if kk != "classes"}),
+         "classes": {c: rounded(b) for c, b in v["classes"].items()}}
+        for k, v in sorted(
+            by_scope.items(),
+            key=lambda kv: (-kv[1]["exposed_ms"], -kv[1]["bytes"]),
+        )
+    ]
+    wire = totals["wire_ms"]
+    return {
+        "rows": rows[:top] if top else rows,
+        "row_count": len(rows),
+        "by_class": {c: rounded(b) for c, b in sorted(
+            by_class.items(), key=lambda kv: -kv[1]["exposed_ms"])},
+        "totals": rounded(totals),
+        "hidden_frac": (
+            round(totals["hidden_ms"] / wire, 4) if wire else None
+        ),
+        "attributed_bytes_frac": (
+            round(attributed / totals["bytes"], 4) if totals["bytes"]
+            else 1.0
+        ),
+        "compute_ms": round(
+            sim.flops / peak * 1e3 if peak else 0.0, 4
+        ),
+        "simulated_step_ms": round(sim.duration_ms, 4),
+        "peak_flops": peak,
+        "peak_source": peak_src,
+        "ici_bytes_per_s": ici_bw,
+        "ici_source": ici_src,
+    }
+
+
+def structural_overlap(hlo_text: str) -> dict:
+    """The integer-only overlap contract of one compiled module: per-scope
+    per-class async-pair/sync counts, payload bytes, and **structurally
+    exposed bytes** — sync payloads (no start/done split exists) plus async
+    pairs whose window schedules zero FLOPs (nothing to hide under).  No
+    cost model, so the projection is stable golden material under a pinned
+    jax (the contract gate's ``overlap`` section)."""
+    # Cost rates don't matter for the structural projection; the nominal
+    # constants keep the walker's arithmetic well-defined.
+    events, _ = _events(hlo_text, 1.0, 1.0)
+    per_scope: Dict[str, Dict[str, dict]] = {}
+    totals = {"async_pairs": 0, "sync": 0, "bytes": 0,
+              "exposed_bytes": 0}
+    for e in events:
+        scope = e.scope or UNSCOPED
+        entry = per_scope.setdefault(scope, {}).setdefault(
+            e.cls, {"async_pairs": 0, "sync": 0, "bytes": 0,
+                    "exposed_bytes": 0}
+        )
+        exposed = e.sync or e.window_flops <= 0.0
+        for b in (entry, totals):
+            b["async_pairs"] += 0 if e.sync else 1
+            b["sync"] += 1 if e.sync else 0
+            b["bytes"] += e.bytes
+            b["exposed_bytes"] += e.bytes if exposed else 0
+    return {
+        "per_scope": {
+            s: dict(sorted(ops.items()))
+            for s, ops in sorted(per_scope.items())
+        },
+        "totals": totals,
+    }
+
+
+def _ms(v: float) -> str:
+    return f"{v:.3f}"
+
+
+def format_ledger(ledger: dict, top: int = 12) -> str:
+    """Human-readable rendering of one overlap ledger (the
+    ``mem_probe --overlap`` stderr table and ``obs report`` wire line)."""
+    t = ledger["totals"]
+    hidden_frac = ledger.get("hidden_frac")
+    lines = [
+        f"exposed-wire ledger (ICI {ledger['ici_bytes_per_s']:.3g} B/s "
+        f"[{ledger['ici_source']}], peak "
+        + (f"{ledger['peak_flops']:.3g} FLOP/s [{ledger['peak_source']}])"
+           if ledger.get("peak_flops") else "n/a)"),
+        f"wire {_ms(t['wire_ms'])} ms over {t['bytes']} bytes — hidden "
+        f"{_ms(t['hidden_ms'])} ms, exposed {_ms(t['exposed_ms'])} ms"
+        + (f" (hidden {hidden_frac:.1%})" if hidden_frac is not None else "")
+        + f"; async pairs {t['async_pairs']}, sync {t['sync']}",
+        f"simulated step {_ms(ledger['simulated_step_ms'])} ms "
+        f"(compute {_ms(ledger['compute_ms'])} ms + exposed wire); "
+        f"{ledger['attributed_bytes_frac']:.1%} of collective bytes "
+        "scope-attributed",
+    ]
+    if ledger.get("by_class"):
+        lines.append("per wire class (exposed/hidden ms, bytes):")
+        for cls, b in ledger["by_class"].items():
+            lines.append(
+                f"  {cls:<18} exposed {_ms(b['exposed_ms']):>9}  hidden "
+                f"{_ms(b['hidden_ms']):>9}  {b['bytes']:>12} B  "
+                f"(async {b['async_pairs']}, sync {b['sync']})"
+            )
+    lines.append(
+        f"{'scope':<44} {'exposed_ms':>10} {'hidden_ms':>10} "
+        f"{'bytes':>12} {'async':>5} {'sync':>5}"
+    )
+    for r in ledger["rows"][:top]:
+        lines.append(
+            f"{r['scope'][:44]:<44} {r['exposed_ms']:>10.3f} "
+            f"{r['hidden_ms']:>10.3f} {r['bytes']:>12} "
+            f"{r['async_pairs']:>5} {r['sync']:>5}"
+        )
+    return "\n".join(lines)

@@ -146,6 +146,30 @@ def render_run(path: str) -> str:
             line += "  scopes: " + ",".join(scopes_seen)
         lines.append(line)
 
+    # -- exposed wire (overlap ledger, next to the pipeline line) ----------
+    for rec in records:
+        if rec.get("kind") != "overlap":
+            continue
+        t = rec.get("totals") or {}
+        label = rec.get("label")
+        hf = rec.get("hidden_frac")
+        lines.append(
+            "wire" + (f" [{label}]" if label else "") + ": "
+            f"{_fmt_bytes(t.get('bytes'))}/step — exposed "
+            f"{t.get('exposed_ms')} ms, hidden {t.get('hidden_ms')} ms"
+            + (f" ({hf:.1%} hidden)" if hf is not None else "")
+            + f"; async pairs {t.get('async_pairs', 0)}, "
+              f"sync {t.get('sync', 0)}; sim step "
+              f"{rec.get('simulated_step_ms')} ms"
+        )
+        exposed_rows = [r for r in (rec.get("rows") or [])
+                        if r.get("exposed_ms")]
+        for r in exposed_rows[:4]:
+            lines.append(
+                f"  {r['exposed_ms']:>10.3f} ms exposed  "
+                f"{_fmt_bytes(r.get('bytes')):>10}  {r['scope']}"
+            )
+
     # -- retraces ----------------------------------------------------------
     sizes = [r.get("jit_cache_size") for r in steps
              if r.get("jit_cache_size") is not None]
@@ -321,12 +345,32 @@ def _probe_peak_gb(records: List[dict]) -> Optional[float]:
     return None
 
 
+def _exposed_wire_ms(records: List[dict]) -> Optional[float]:
+    """Exposed-wire time from ``overlap`` records (best probed row, like
+    the mem_probe peak metric), falling back to the timeline record's
+    schedule-aware block for older artifacts."""
+    vals = [
+        float(r["totals"]["exposed_ms"]) for r in records
+        if r.get("kind") == "overlap"
+        and (r.get("totals") or {}).get("exposed_ms") is not None
+    ]
+    if vals:
+        return min(vals)
+    for r in records:
+        sa = (r.get("schedule_aware") or {}) if r.get("kind") == "timeline" \
+            else {}
+        if sa.get("exposed_wire_ms") is not None:
+            return float(sa["exposed_wire_ms"])
+    return None
+
+
 _COMPARE_METRICS = [
     ("step ms (median)", "lower", _median_ms),
     ("images/sec (mean)", "higher", _mean_ips),
     ("peak HBM bytes", "lower", _peak_hbm),
     ("collective bytes/step", "lower", _coll_bytes),
     ("mem_probe peak GB", "lower", _probe_peak_gb),
+    ("exposed wire ms", "lower", _exposed_wire_ms),
 ]
 
 

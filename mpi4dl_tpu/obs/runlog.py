@@ -1,8 +1,10 @@
 """RunLog: per-run JSONL telemetry sink.
 
 One run = one ``.jsonl`` file; one line = one record, every record carrying
-``kind`` (meta | cost | step | summary | <custom>), ``t`` (unix seconds) and
-``schema``.  The first record is the run's metadata — full config, mesh spec,
+``kind`` (meta | cost | step | summary | hbm | timeline | overlap |
+mem_probe | junction_sweep | xprof_ops | readiness | anomaly | recovery |
+preempt | <custom> — field reference in docs/observability.md), ``t`` (unix
+seconds) and ``schema``.  The first record is the run's metadata — full config, mesh spec,
 device kind, jax version, active ``MPI4DL_*`` hatches — so a step file is
 self-describing: no PERF_NOTES archaeology to learn what produced it
 (VERDICT r4 weak-9, the bench ladder's rung_config lesson applied to every

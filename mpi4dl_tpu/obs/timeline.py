@@ -10,6 +10,9 @@ serialized-vs-overlappable report: the serialized total assumes no
 compute/communication overlap, the overlapped bound assumes perfect overlap
 — the gap is the budget the T3-style halo-RDMA work (ROADMAP item 2, arXiv
 2401.16677) can win, now measurable per scope before any silicon run.
+Between the two brackets, the ``schedule_aware`` block (obs/overlap.py's
+ledger) reports where the compiled schedule actually lands: which wire
+milliseconds hide under async start/done windows and which are exposed.
 
 Also the canonical home of the pipeline-schedule tick/bubble arithmetic
 (:func:`pipeline_ticks` / :func:`bubble_fraction`, docs/pipeline.md):
@@ -35,18 +38,32 @@ from mpi4dl_tpu.obs.costs import (
 )
 from mpi4dl_tpu.obs.hbm import Instr, parse_hlo_module, shape_bytes
 
-_COLLECTIVE_OPS = {
-    "collective-permute": "collective-permute",
-    "collective-permute-start": "collective-permute",
-    "all-reduce": "all-reduce",
-    "all-reduce-start": "all-reduce",
-    "all-gather": "all-gather",
-    "all-gather-start": "all-gather",
-    "reduce-scatter": "reduce-scatter",
-    "reduce-scatter-start": "reduce-scatter",
-    "all-to-all": "all-to-all",
-    "all-to-all-start": "all-to-all",
-}
+#: HLO collective opcodes with a payload on the inter-chip wire.  The bare
+#: opcode is the sync form; ``<base>-start``/``<base>-done`` are the async
+#: halves; generic ``async-start``/``async-update``/``async-done`` wrap any
+#: of them with the real collective inside the wrapped computation.
+COLLECTIVE_BASES = (
+    "collective-permute", "all-reduce", "all-gather", "reduce-scatter",
+    "all-to-all",
+)
+
+#: Generic async plumbing opcodes.  Never counted as collectives of their
+#: own: the payload is accounted exactly once — at the named ``*-start``
+#: (async pairs) or at the collective inside the wrapped computation
+#: (generic wrappers), with ``*-done`` always skipped — so per-scope
+#: collective costs can't double-count a start/done pair.
+ASYNC_GLUE_OPS = ("async-start", "async-update", "async-done")
+
+
+def collective_base(opcode: str) -> Optional[str]:
+    """Async-opcode normalization: ``all-gather-start`` -> ``all-gather``,
+    ``collective-permute-done`` -> ``collective-permute``; None for
+    non-collective opcodes, including the generic ``async-*`` glue (their
+    wire class lives in the wrapped computation)."""
+    for suffix in ("-start", "-done"):
+        if opcode.endswith(suffix):
+            opcode = opcode[: -len(suffix)]
+    return opcode if opcode in COLLECTIVE_BASES else None
 
 _DIMS = re.compile(r"\[([0-9,]*)\]")
 
@@ -65,14 +82,19 @@ def _prod(xs) -> int:
     return n
 
 
-def instr_flops(ins: Instr, line_attrs: str = "") -> float:
+def instr_flops(ins: Instr, line_attrs: Optional[str] = None) -> float:
     """Analytical FLOPs of one HLO instruction (0 for non-conv/dot ops).
 
     conv: 2 x out_elems x (kernel elements / out_features) — the per-output
     MAC count; kernel shape already folds in ``feature_group_count`` (its
     input-feature dim is per-group), so grouped/depthwise convs are right.
     dot: 2 x out_elems x contracted extent (from ``lhs_contracting_dims``).
+
+    ``line_attrs`` defaults to the instruction's own raw line (the parser
+    keeps it on :class:`~mpi4dl_tpu.obs.hbm.Instr`).
     """
+    if line_attrs is None:
+        line_attrs = ins.raw
     # Operand shapes live after the opcode's '(' — slicing there keeps the
     # defined (output) shape out of the operand-shape scan.
     cut = line_attrs.find(ins.opcode + "(")
@@ -110,16 +132,14 @@ def hlo_scope_costs(hlo_text: str) -> Dict[str, Dict[str, float]]:
     compiled HLO module's text.  Scope keys are the obs.scope vocabulary
     (:func:`~mpi4dl_tpu.obs.hlo_stats.clean_scope_path`); ops without a
     scope path aggregate under ``""``.  Walks every computation (fusion
-    bodies carry the conv/dot instructions' metadata), counting async
-    collective ``-start``/``-done`` pairs once."""
+    bodies carry the conv/dot instructions' metadata).
+
+    Async normalization (:func:`collective_base`): a start/done pair counts
+    exactly once — at the ``*-start`` with the result payload's bytes, with
+    every ``*-done`` and the generic ``async-*`` glue skipped; a collective
+    inside a generic async wrapper's computation counts once via the flat
+    computation walk (its wrapper is glue, not a second collective)."""
     comps, _ = parse_hlo_module(hlo_text)
-    # Re-scan the raw text per instruction name for attribute strings the
-    # Instr dataclass doesn't keep (window/dim_labels/contracting dims).
-    attr_by_name: Dict[str, str] = {}
-    for line in hlo_text.splitlines():
-        m = re.match(r"\s*(?:ROOT\s+)?(%[\w.\-]+)\s*=", line)
-        if m:
-            attr_by_name[m.group(1)] = line
     out: Dict[str, Dict[str, float]] = {}
 
     def bucket(scope: str) -> Dict[str, float]:
@@ -130,20 +150,24 @@ def hlo_scope_costs(hlo_text: str) -> Dict[str, Dict[str, float]]:
     for instrs in comps.values():
         for ins in instrs:
             if ins.opcode in ("convolution", "dot"):
-                fl = instr_flops(ins, attr_by_name.get(ins.name, ""))
+                fl = instr_flops(ins)
                 if fl:
                     bucket(ins.scope)["flops"] += fl
-            elif ins.opcode in _COLLECTIVE_OPS:
-                b = bucket(ins.scope)
-                nbytes = ins.bytes
-                if ins.opcode.endswith("-start"):
-                    # Start tuples are (operand, result[, ctx]); count the
-                    # result payload, matching hlo_collective_stats.
-                    shapes = re.findall(r"\w+\[[0-9,]*\]", ins.shape)
-                    if len(shapes) > 1:
-                        nbytes = shape_bytes(shapes[1])
-                b["collective_bytes"] += nbytes
-                b["collective_count"] += 1
+                continue
+            if ins.opcode in ASYNC_GLUE_OPS or ins.opcode.endswith("-done"):
+                continue  # counted at the start / in the wrapped body
+            if collective_base(ins.opcode) is None:
+                continue
+            b = bucket(ins.scope)
+            nbytes = ins.bytes
+            if ins.opcode.endswith("-start"):
+                # Start tuples are (operand, result[, ctx]); count the
+                # result payload, matching hlo_collective_stats.
+                shapes = re.findall(r"\w+\[[0-9,]*\]", ins.shape)
+                if len(shapes) > 1:
+                    nbytes = shape_bytes(shapes[1])
+            b["collective_bytes"] += nbytes
+            b["collective_count"] += 1
     return out
 
 
@@ -206,6 +230,9 @@ def analytical_timeline(
         else:
             ici_bw, ici_src = DEFAULT_ICI_BYTES_PER_S, "default"
 
+    # Late import: obs/overlap.py imports this module's cost primitives.
+    from mpi4dl_tpu.obs.overlap import overlap_ledger
+
     costs = hlo_scope_costs(hlo_text)
     rows = []
     tot_compute_ms = tot_coll_ms = 0.0
@@ -232,6 +259,11 @@ def analytical_timeline(
 
     serialized = tot_compute_ms + tot_coll_ms
     overlapped = max(tot_compute_ms, tot_coll_ms)
+    # Schedule-aware refinement of the serialized/perfect-overlap brackets:
+    # the compiled module's own schedule says which wire time is actually
+    # hidden under compute (obs/overlap.py; async start/done windows vs
+    # structurally-sync collectives).
+    ledger = overlap_ledger(hlo_text, peak=peak, ici_bw=ici_bw)
     out = {
         "rows": rows,
         "total_flops": tot_flops,
@@ -241,6 +273,14 @@ def analytical_timeline(
         "serialized_ms": round(serialized, 4),
         "overlapped_ms": round(overlapped, 4),
         "overlap_headroom_ms": round(serialized - overlapped, 4),
+        "schedule_aware": {
+            "simulated_step_ms": ledger["simulated_step_ms"],
+            "exposed_wire_ms": ledger["totals"]["exposed_ms"],
+            "hidden_wire_ms": ledger["totals"]["hidden_ms"],
+            "hidden_frac": ledger["hidden_frac"],
+            "async_pairs": ledger["totals"]["async_pairs"],
+            "sync_collectives": ledger["totals"]["sync"],
+        },
         "peak_flops": peak,
         "peak_source": peak_src,
         "ici_bytes_per_s": ici_bw,
@@ -274,6 +314,17 @@ def format_timeline(tl: dict, top: int = 12) -> str:
         f"perfect overlap {tl['overlapped_ms']:.3f} ms "
         f"(headroom {tl['overlap_headroom_ms']:.3f} ms)",
     ]
+    sa = tl.get("schedule_aware")
+    if sa:
+        hf = sa.get("hidden_frac")
+        lines.append(
+            f"schedule-aware: simulated step {sa['simulated_step_ms']:.3f} "
+            f"ms — exposed wire {sa['exposed_wire_ms']:.3f} ms, hidden "
+            f"{sa['hidden_wire_ms']:.3f} ms"
+            + (f" ({hf:.1%} hidden)" if hf is not None else "")
+            + f"; async pairs {sa['async_pairs']}, "
+              f"sync {sa['sync_collectives']}"
+        )
     pipe = tl.get("pipeline")
     if pipe:
         lines.append(

@@ -119,13 +119,27 @@ def test_injected_collective_names_offending_scope(devices8, monkeypatch):
     # the jaxpr per-axis view corroborates: more ppermutes on the spw axis
     axis = [d for d in drifts if d["kind"] == "axis-collective"]
     assert any(d["axis"] == "spw" and d["op"] == "ppermute" for d in axis)
+    # the overlap section corroborates from the COMPILED schedule: the
+    # injected hop lands as extra sync (unsplit on the CPU backend)
+    # collective-permutes, localized to the same halo scopes — the ISSUE 9
+    # negative test that a sync collective is flagged where it lives
+    ovl = [d for d in drifts if d["kind"] == "overlap"]
+    assert ovl, f"no overlap drift in {drifts}"
+    for d in ovl:
+        assert "halo_exchange_spw" in d["scope"], d
+        assert d["op"] == "collective-permute", d
+        assert d["sync_current"] > d["sync_golden"], d
+        assert d["exposed_bytes_current"] > d["exposed_bytes_golden"], d
     # no unrelated drift kinds (scope coverage, shardings, retrace budget
     # must be untouched by this perturbation)
-    assert {d["kind"] for d in drifts} == {"collective", "axis-collective"}
+    assert {d["kind"] for d in drifts} == {
+        "collective", "axis-collective", "overlap"
+    }
 
     report = render_drift_report("sp", drifts)
     assert "halo_exchange_spw" in report
     assert "collective_permute" in report
+    assert "overlap scope" in report
 
 
 # ---------------------------------------------------------------------------
@@ -207,6 +221,48 @@ def test_diff_sharding_annotations():
     drifts = diff_contracts(_synthetic(), current)
     assert any(d["kind"] == "sharding" and "devices=[1,2]" in d["annotation"]
                for d in drifts)
+
+
+def _overlap_section(async_pairs, sync, exposed):
+    return {
+        "per_scope": {
+            "cell00/halo_exchange_spw": {
+                "collective-permute": {
+                    "async_pairs": async_pairs, "sync": sync,
+                    "bytes": 1024, "exposed_bytes": exposed,
+                },
+            },
+        },
+        "totals": {"async_pairs": async_pairs, "sync": sync,
+                   "bytes": 1024, "exposed_bytes": exposed},
+    }
+
+
+def test_diff_overlap_lost_async_split():
+    """An async collective that compiles sync (loses its start/done split)
+    drifts the overlap section, localized to its scope, and the report
+    says what happened."""
+    golden = _synthetic(overlap=_overlap_section(4, 0, 0))
+    current = _synthetic(overlap=_overlap_section(3, 1, 256))
+    drifts = diff_contracts(golden, current)
+    ovl = [d for d in drifts if d["kind"] == "overlap"]
+    assert len(ovl) == 1
+    d = ovl[0]
+    assert d["scope"] == "cell00/halo_exchange_spw"
+    assert d["op"] == "collective-permute"
+    assert d["sync_golden"] == 0 and d["sync_current"] == 1
+    assert d["exposed_bytes_current"] == 256
+    report = render_drift_report("sp", drifts)
+    assert "overlap scope cell00/halo_exchange_spw" in report
+    assert "LOST its start/done split" in report
+    # The reverse direction (a collective GAINS its split) drifts too but
+    # without the lost-split callout.
+    report = render_drift_report("sp", diff_contracts(current, golden))
+    assert "overlap scope" in report
+    assert "LOST" not in report
+    # Identical overlap sections are clean.
+    assert diff_contracts(golden, _synthetic(
+        overlap=_overlap_section(4, 0, 0))) == []
 
 
 def test_diff_meta_mismatch_short_circuits():

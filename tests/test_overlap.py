@@ -1,0 +1,566 @@
+"""Tests for the exposed-wire overlap ledger (obs/overlap.py) — ISSUE 9.
+
+Covers: the schedule walker on synthetic scheduled modules with
+hand-computed hidden/exposed windows (fully-hidden, fully-exposed,
+partially-overlapping, sync-collective, nested-while, generic async-wrapper
+cases); the async-opcode normalization regression (start/done pairs counted
+exactly once in per-scope collective costs, all five classes + the generic
+``async-*`` glue); the structural projection the contract gate pins; ledger
+sanity on the real lp/sp engine families on the virtual mesh (>=90% of
+collective bytes scope-attributed — the acceptance gate; gems families ride
+``-m slow``); the ``mem_probe --overlap`` CLI with the ``--require-hidden-
+frac`` gate; and the ``obs report --compare`` exposed-wire metric.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import jax
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mpi4dl_tpu.obs import overlap, timeline
+from mpi4dl_tpu.obs.hlo_stats import hlo_collective_stats
+from mpi4dl_tpu.obs.report import compare_runs
+
+# ---------------------------------------------------------------------------
+# Synthetic scheduled modules.  Nominal rates are passed explicitly:
+# peak 1e11 FLOP/s and ICI 1e10 B/s, so a f32[1000,1000] @ f32[1000,1000]
+# dot is 2e9 FLOPs = 20 ms and a 10^6-byte payload is 0.1 ms of wire.
+# ---------------------------------------------------------------------------
+
+_PEAK = 1e11
+_ICI = 1e10
+
+_DOT_BIG = (
+    "%dot.{n} = f32[1000,1000]{{1,0}} dot(f32[1000,1000]{{1,0}} %p0, "
+    "f32[1000,1000]{{1,0}} %p0), lhs_contracting_dims={{1}}, "
+    "rhs_contracting_dims={{0}}, "
+    'metadata={{op_name="jit(step)/jit(main)/cell{n:02d}/dot_general"}}'
+)
+
+
+def _module(body: str) -> str:
+    head = [
+        "HloModule jit_step, is_scheduled=true",
+        "",
+        "%add (a: f32[], b: f32[]) -> f32[] {",
+        "  %a = f32[] parameter(0)",
+        "  %b = f32[] parameter(1)",
+        "  ROOT %s = f32[] add(f32[] %a, f32[] %b)",
+        "}",
+        "",
+    ]
+    return "\n".join(head) + body
+
+
+# Async ppermute (1e6 B = 0.1 ms) issued before a 20 ms dot: fully hidden.
+_HIDDEN = _module(f"""\
+ENTRY %main (p0: f32[1000,1000], p1: f32[500,500]) -> f32[1000,1000] {{
+  %p0 = f32[1000,1000]{{1,0}} parameter(0)
+  %p1 = f32[500,500]{{1,0}} parameter(1)
+  %cps = (f32[500,500]{{1,0}}, f32[500,500]{{1,0}}) collective-permute-start(f32[500,500]{{1,0}} %p1), source_target_pairs={{{{0,1}},{{1,0}}}}, metadata={{op_name="jit(step)/jit(main)/halo_exchange_spw/ppermute"}}
+  {_DOT_BIG.format(n=0)}
+  %cpd = f32[500,500]{{1,0}} collective-permute-done((f32[500,500]{{1,0}}, f32[500,500]{{1,0}}) %cps), metadata={{op_name="jit(step)/jit(main)/halo_exchange_spw/ppermute"}}
+  ROOT %r = f32[1000,1000]{{1,0}} negate(f32[1000,1000]{{1,0}} %dot.0)
+}}
+""")
+
+# The same pair with NOTHING scheduled inside the window: fully exposed.
+_EXPOSED = _module(f"""\
+ENTRY %main (p0: f32[1000,1000], p1: f32[500,500]) -> f32[1000,1000] {{
+  %p0 = f32[1000,1000]{{1,0}} parameter(0)
+  %p1 = f32[500,500]{{1,0}} parameter(1)
+  %cps = (f32[500,500]{{1,0}}, f32[500,500]{{1,0}}) collective-permute-start(f32[500,500]{{1,0}} %p1), source_target_pairs={{{{0,1}},{{1,0}}}}, metadata={{op_name="jit(step)/jit(main)/halo_exchange_spw/ppermute"}}
+  %cpd = f32[500,500]{{1,0}} collective-permute-done((f32[500,500]{{1,0}}, f32[500,500]{{1,0}}) %cps), metadata={{op_name="jit(step)/jit(main)/halo_exchange_spw/ppermute"}}
+  {_DOT_BIG.format(n=0)}
+  ROOT %r = f32[1000,1000]{{1,0}} negate(f32[1000,1000]{{1,0}} %dot.0)
+}}
+""")
+
+# A 10^7-byte all-gather (1.0 ms wire) with a 0.4 ms dot in the window:
+# hidden 0.4 ms, exposed 0.6 ms.  Start tuple result is the gathered shape.
+_PARTIAL = _module("""\
+ENTRY %main (p0: f32[200,500], p1: f32[1250,1000]) -> f32[200,200] {
+  %p0 = f32[200,500]{1,0} parameter(0)
+  %p1 = f32[1250,1000]{1,0} parameter(1)
+  %ags = (f32[1250,1000]{1,0}, f32[2500,1000]{1,0}) all-gather-start(f32[1250,1000]{1,0} %p1), dimensions={0}, metadata={op_name="jit(step)/jit(main)/junction_gather/all_gather"}
+  %dot.0 = f32[200,200]{1,0} dot(f32[200,500]{1,0} %p0, f32[500,200]{1,0} %p0), lhs_contracting_dims={1}, rhs_contracting_dims={0}, metadata={op_name="jit(step)/jit(main)/cell00/dot_general"}
+  %agd = f32[2500,1000]{1,0} all-gather-done((f32[1250,1000]{1,0}, f32[2500,1000]{1,0}) %ags), metadata={op_name="jit(step)/jit(main)/junction_gather/all_gather"}
+  ROOT %r = f32[200,200]{1,0} negate(f32[200,200]{1,0} %dot.0)
+}
+""")
+
+# A sync (unsplit) reduce-scatter: structurally unhideable no matter how
+# much compute surrounds it.
+_SYNC = _module(f"""\
+ENTRY %main (p0: f32[1000,1000], p1: f32[500,500]) -> f32[500,500] {{
+  %p0 = f32[1000,1000]{{1,0}} parameter(0)
+  %p1 = f32[500,500]{{1,0}} parameter(1)
+  {_DOT_BIG.format(n=0)}
+  %rs = f32[500,500]{{1,0}} reduce-scatter(f32[500,500]{{1,0}} %p1), replica_groups={{{{0,1}}}}, dimensions={{0}}, to_apply=%add, metadata={{op_name="jit(step)/jit(main)/respatial_l0/reduce_scatter"}}
+  {_DOT_BIG.format(n=1)}
+  ROOT %r = f32[500,500]{{1,0}} negate(f32[500,500]{{1,0}} %rs)
+}}
+""")
+
+# A while whose body carries a sync all-reduce next to a 20 ms dot: the
+# body simulates once at the call site (structural, trip counts unfolded),
+# its collective exposed in the body's own scope.
+_NESTED = _module(f"""\
+%body (bp: (s32[], f32[1000,1000], f32[500,500])) -> (s32[], f32[1000,1000], f32[500,500]) {{
+  %bp = (s32[], f32[1000,1000]{{1,0}}, f32[500,500]{{1,0}}) parameter(0)
+  %g0 = s32[] get-tuple-element((s32[], f32[1000,1000]{{1,0}}, f32[500,500]{{1,0}}) %bp), index=0
+  %p0 = f32[1000,1000]{{1,0}} get-tuple-element((s32[], f32[1000,1000]{{1,0}}, f32[500,500]{{1,0}}) %bp), index=1
+  %g2 = f32[500,500]{{1,0}} get-tuple-element((s32[], f32[1000,1000]{{1,0}}, f32[500,500]{{1,0}}) %bp), index=2
+  {_DOT_BIG.format(n=3)}
+  %ar = f32[500,500]{{1,0}} all-reduce(f32[500,500]{{1,0}} %g2), replica_groups={{{{0,1}}}}, to_apply=%add, metadata={{op_name="jit(step)/jit(main)/tail_scan/grad_reduce/psum"}}
+  ROOT %bt = (s32[], f32[1000,1000]{{1,0}}, f32[500,500]{{1,0}}) tuple(s32[] %g0, f32[1000,1000]{{1,0}} %dot.3, f32[500,500]{{1,0}} %ar)
+}}
+
+%cond (cp: (s32[], f32[1000,1000], f32[500,500])) -> pred[] {{
+  %cp = (s32[], f32[1000,1000]{{1,0}}, f32[500,500]{{1,0}}) parameter(0)
+  %g = s32[] get-tuple-element((s32[], f32[1000,1000]{{1,0}}, f32[500,500]{{1,0}}) %cp), index=0
+  %c = s32[] constant(3)
+  ROOT %lt = pred[] compare(s32[] %g, s32[] %c), direction=LT
+}}
+
+ENTRY %main (p0: f32[1000,1000], p1: f32[500,500]) -> f32[1000,1000] {{
+  %p0 = f32[1000,1000]{{1,0}} parameter(0)
+  %p1 = f32[500,500]{{1,0}} parameter(1)
+  %zero = s32[] constant(0)
+  %init = (s32[], f32[1000,1000]{{1,0}}, f32[500,500]{{1,0}}) tuple(s32[] %zero, f32[1000,1000]{{1,0}} %p0, f32[500,500]{{1,0}} %p1)
+  %loop = (s32[], f32[1000,1000]{{1,0}}, f32[500,500]{{1,0}}) while((s32[], f32[1000,1000]{{1,0}}, f32[500,500]{{1,0}}) %init), condition=%cond, body=%body
+  ROOT %res = f32[1000,1000]{{1,0}} get-tuple-element((s32[], f32[1000,1000]{{1,0}}, f32[500,500]{{1,0}}) %loop), index=1
+}}
+""")
+
+# The generic async wrapper: async-start/async-done around an all-to-all in
+# a wrapped computation — counted once, with the wrapped op's class/scope.
+_ASYNC_WRAP = _module(f"""\
+%wrapped (wp: f32[500,500]) -> f32[500,500] {{
+  %wp = f32[500,500]{{1,0}} parameter(0)
+  ROOT %a2a = f32[500,500]{{1,0}} all-to-all(f32[500,500]{{1,0}} %wp), replica_groups={{{{0,1}}}}, dimensions={{0}}, metadata={{op_name="jit(step)/jit(main)/junction_batch_split_a2a/all_to_all"}}
+}}
+
+ENTRY %main (p0: f32[1000,1000], p1: f32[500,500]) -> f32[1000,1000] {{
+  %p0 = f32[1000,1000]{{1,0}} parameter(0)
+  %p1 = f32[500,500]{{1,0}} parameter(1)
+  %as = ((f32[500,500]{{1,0}}), f32[500,500]{{1,0}}, s32[]) async-start(f32[500,500]{{1,0}} %p1), calls=%wrapped
+  {_DOT_BIG.format(n=0)}
+  %ad = f32[500,500]{{1,0}} async-done(((f32[500,500]{{1,0}}), f32[500,500]{{1,0}}, s32[]) %as), calls=%wrapped
+  ROOT %r = f32[1000,1000]{{1,0}} negate(f32[1000,1000]{{1,0}} %dot.0)
+}}
+""")
+
+
+def _ledger(text):
+    return overlap.overlap_ledger(text, peak=_PEAK, ici_bw=_ICI)
+
+
+def test_fully_hidden_window():
+    led = _ledger(_HIDDEN)
+    t = led["totals"]
+    assert t["async_pairs"] == 1 and t["sync"] == 0
+    assert t["bytes"] == 1_000_000
+    assert t["wire_ms"] == pytest.approx(0.1)
+    assert t["hidden_ms"] == pytest.approx(0.1)
+    assert t["exposed_ms"] == pytest.approx(0.0)
+    assert led["hidden_frac"] == pytest.approx(1.0)
+    # 20 ms dot + nothing exposed.
+    assert led["simulated_step_ms"] == pytest.approx(20.0)
+    row = led["rows"][0]
+    assert row["scope"] == "halo_exchange_spw"
+    assert "collective-permute" in row["classes"]
+
+
+def test_fully_exposed_window():
+    led = _ledger(_EXPOSED)
+    t = led["totals"]
+    assert t["async_pairs"] == 1
+    assert t["hidden_ms"] == pytest.approx(0.0)
+    assert t["exposed_ms"] == pytest.approx(0.1)
+    assert led["hidden_frac"] == pytest.approx(0.0)
+    # The stall adds to the step: 20 ms dot + 0.1 ms exposed wire.
+    assert led["simulated_step_ms"] == pytest.approx(20.1)
+
+
+def test_partially_overlapping_window():
+    led = _ledger(_PARTIAL)
+    t = led["totals"]
+    # Payload = the gathered result: 2500*1000*4 = 10^7 B = 1.0 ms wire.
+    assert t["bytes"] == 10_000_000
+    assert t["wire_ms"] == pytest.approx(1.0)
+    # Window compute: 2*200*200*500 = 4e7 FLOPs = 0.4 ms.
+    assert t["hidden_ms"] == pytest.approx(0.4)
+    assert t["exposed_ms"] == pytest.approx(0.6)
+    assert led["hidden_frac"] == pytest.approx(0.4)
+    assert led["rows"][0]["scope"] == "junction_gather"
+    assert led["simulated_step_ms"] == pytest.approx(0.4 + 0.6)
+
+
+def test_sync_collective_fully_exposed():
+    led = _ledger(_SYNC)
+    t = led["totals"]
+    assert t["async_pairs"] == 0 and t["sync"] == 1
+    assert t["hidden_ms"] == pytest.approx(0.0)
+    assert t["exposed_ms"] == pytest.approx(0.1)
+    assert led["rows"][0]["scope"] == "respatial_l0"
+    assert led["by_class"]["respatial"]["sync"] == 1
+    # 2 dots (40 ms) + the unhideable 0.1 ms.
+    assert led["simulated_step_ms"] == pytest.approx(40.1)
+
+
+def test_nested_while_collective():
+    led = _ledger(_NESTED)
+    t = led["totals"]
+    # The body's collective counts once (structural; trips unfolded).
+    assert t["sync"] == 1 and t["async_pairs"] == 0
+    assert t["exposed_ms"] == pytest.approx(0.1)
+    assert led["rows"][0]["scope"] == "tail_scan/grad_reduce"
+    # Step = body once (20 ms dot + 0.1 ms sync wire).
+    assert led["simulated_step_ms"] == pytest.approx(20.1)
+
+
+def test_generic_async_wrapper_counted_once():
+    led = _ledger(_ASYNC_WRAP)
+    t = led["totals"]
+    assert t["async_pairs"] == 1 and t["sync"] == 0
+    assert t["bytes"] == 1_000_000
+    # Hidden under the 20 ms dot in the window.
+    assert t["hidden_ms"] == pytest.approx(0.1)
+    row = led["rows"][0]
+    assert row["scope"] == "junction_batch_split_a2a"
+    assert "all-to-all" in row["classes"]
+
+
+def test_structural_projection():
+    s = overlap.structural_overlap(_HIDDEN)
+    assert s["totals"] == {"async_pairs": 1, "sync": 0,
+                           "bytes": 1_000_000, "exposed_bytes": 0}
+    # Zero-FLOP window: structurally exposed even though async.
+    s = overlap.structural_overlap(_EXPOSED)
+    assert s["totals"]["exposed_bytes"] == 1_000_000
+    # Sync: exposed and localized to its scope with the class named.
+    s = overlap.structural_overlap(_SYNC)
+    assert s["totals"] == {"async_pairs": 0, "sync": 1,
+                           "bytes": 1_000_000, "exposed_bytes": 1_000_000}
+    assert s["per_scope"]["respatial_l0"]["reduce-scatter"]["sync"] == 1
+    # Partial window with compute: structurally hideable.
+    s = overlap.structural_overlap(_PARTIAL)
+    assert s["totals"]["exposed_bytes"] == 0
+
+
+def test_format_ledger_renders():
+    text = overlap.format_ledger(_ledger(_PARTIAL))
+    assert "junction_gather" in text
+    assert "exposed" in text and "hidden" in text
+    assert "async pairs 1" in text
+
+
+# ---------------------------------------------------------------------------
+# Async-opcode normalization regression (ISSUE 9 satellite): start/done
+# pairs count exactly once in the per-scope collective costs, for every
+# class and for the generic async-* glue.
+# ---------------------------------------------------------------------------
+
+
+_ALL_VARIANTS = _module("""\
+%wrapped (wp: f32[500,500]) -> f32[500,500] {
+  %wp = f32[500,500]{1,0} parameter(0)
+  ROOT %a2a = f32[500,500]{1,0} all-to-all(f32[500,500]{1,0} %wp), replica_groups={{0,1}}, dimensions={0}, metadata={op_name="jit(step)/jit(main)/scope_a2a/all_to_all"}
+}
+
+ENTRY %main (p0: f32[500,500]) -> f32[500,500] {
+  %p0 = f32[500,500]{1,0} parameter(0)
+  %cps = (f32[500,500]{1,0}, f32[500,500]{1,0}) collective-permute-start(f32[500,500]{1,0} %p0), source_target_pairs={{0,1},{1,0}}, metadata={op_name="jit(step)/jit(main)/scope_cp/ppermute"}
+  %cpd = f32[500,500]{1,0} collective-permute-done((f32[500,500]{1,0}, f32[500,500]{1,0}) %cps), metadata={op_name="jit(step)/jit(main)/scope_cp/ppermute"}
+  %ars = f32[500,500]{1,0} all-reduce-start(f32[500,500]{1,0} %cpd), replica_groups={{0,1}}, to_apply=%add, metadata={op_name="jit(step)/jit(main)/scope_ar/psum"}
+  %ard = f32[500,500]{1,0} all-reduce-done(f32[500,500]{1,0} %ars), metadata={op_name="jit(step)/jit(main)/scope_ar/psum"}
+  %ags = (f32[500,500]{1,0}, f32[1000,500]{1,0}) all-gather-start(f32[500,500]{1,0} %ard), dimensions={0}, metadata={op_name="jit(step)/jit(main)/scope_ag/all_gather"}
+  %agd = f32[1000,500]{1,0} all-gather-done((f32[500,500]{1,0}, f32[1000,500]{1,0}) %ags), metadata={op_name="jit(step)/jit(main)/scope_ag/all_gather"}
+  %rss = (f32[1000,500]{1,0}, f32[500,500]{1,0}) reduce-scatter-start(f32[1000,500]{1,0} %agd), replica_groups={{0,1}}, dimensions={0}, to_apply=%add, metadata={op_name="jit(step)/jit(main)/scope_rs/reduce_scatter"}
+  %rsd = f32[500,500]{1,0} reduce-scatter-done((f32[1000,500]{1,0}, f32[500,500]{1,0}) %rss), metadata={op_name="jit(step)/jit(main)/scope_rs/reduce_scatter"}
+  %as = ((f32[500,500]{1,0}), f32[500,500]{1,0}, s32[]) async-start(f32[500,500]{1,0} %rsd), calls=%wrapped
+  %au = ((f32[500,500]{1,0}), f32[500,500]{1,0}, s32[]) async-update(((f32[500,500]{1,0}), f32[500,500]{1,0}, s32[]) %as), calls=%wrapped
+  %ad = f32[500,500]{1,0} async-done(((f32[500,500]{1,0}), f32[500,500]{1,0}, s32[]) %au), calls=%wrapped
+  ROOT %sync = f32[500,500]{1,0} all-reduce(f32[500,500]{1,0} %ad), replica_groups={{0,1}}, to_apply=%add, metadata={op_name="jit(step)/jit(main)/scope_sync/psum"}
+}
+""")
+
+_MB = 500 * 500 * 4  # one f32[500,500] payload
+
+
+def test_async_normalization_no_double_count():
+    # collective_base: every start/done maps to its class; glue maps to None.
+    assert timeline.collective_base("all-gather-start") == "all-gather"
+    assert timeline.collective_base("all-gather-done") == "all-gather"
+    assert timeline.collective_base("all-reduce-start") == "all-reduce"
+    assert timeline.collective_base("reduce-scatter-done") == "reduce-scatter"
+    assert timeline.collective_base("collective-permute-start") \
+        == "collective-permute"
+    assert timeline.collective_base("all-to-all") == "all-to-all"
+    assert timeline.collective_base("async-start") is None
+    assert timeline.collective_base("async-done") is None
+    assert timeline.collective_base("copy-start") is None
+    assert timeline.collective_base("fusion") is None
+
+    costs = timeline.hlo_scope_costs(_ALL_VARIANTS)
+    # Exactly one collective per scope — the done halves and async glue
+    # must not double-count the pair.
+    for scope in ("scope_cp", "scope_ar", "scope_ag", "scope_rs",
+                  "scope_a2a", "scope_sync"):
+        assert costs[scope]["collective_count"] == 1, (scope, costs)
+    # Start tuples count the RESULT payload: the all-gather result is the
+    # gathered (doubled) shape, reduce-scatter's the scattered shard.
+    assert costs["scope_cp"]["collective_bytes"] == _MB
+    assert costs["scope_ag"]["collective_bytes"] == 2 * _MB
+    assert costs["scope_rs"]["collective_bytes"] == _MB
+    # The ledger agrees op-for-op: 5 async pairs + 1 sync.
+    led = _ledger(_ALL_VARIANTS)
+    assert led["totals"]["async_pairs"] == 5
+    assert led["totals"]["sync"] == 1
+    assert led["totals"]["bytes"] == sum(
+        c["collective_bytes"] for c in costs.values()
+    )
+
+
+def test_timeline_schedule_aware_block():
+    tl = timeline.analytical_timeline(_PARTIAL, peak=_PEAK, ici_bw=_ICI)
+    sa = tl["schedule_aware"]
+    assert sa["exposed_wire_ms"] == pytest.approx(0.6)
+    assert sa["hidden_wire_ms"] == pytest.approx(0.4)
+    assert sa["async_pairs"] == 1 and sa["sync_collectives"] == 0
+    # The simulated step refines the brackets: between perfect overlap and
+    # fully serialized.
+    assert tl["overlapped_ms"] <= sa["simulated_step_ms"] + 1e-9
+    assert sa["simulated_step_ms"] <= tl["serialized_ms"] + 1e-9
+    assert "schedule-aware" in timeline.format_timeline(tl)
+
+
+def test_wire_class_vocabulary():
+    assert overlap.wire_class("sp_region/cell00/halo_exchange_spw",
+                              "collective-permute") == "halo"
+    assert overlap.wire_class("junction_gather", "all-gather") == "junction"
+    assert overlap.wire_class("stage_lineup", "all-gather") == "junction"
+    assert overlap.wire_class("respatial_l1", "reduce-scatter") \
+        == "respatial"
+    assert overlap.wire_class("tail_scan/stage_handoff",
+                              "collective-permute") == "pipeline_handoff"
+    assert overlap.wire_class("grad_reduce", "all-reduce") \
+        == "grad_stats_reduce"
+    # Unknown scopes fall back to the HLO class.
+    assert overlap.wire_class("", "all-reduce") == "all-reduce"
+
+
+# ---------------------------------------------------------------------------
+# Real engine families on the virtual mesh: the ledger must attribute >=90%
+# of collective bytes to named scopes (acceptance gate) and agree with the
+# flat collective accounting.  lp/sp are tier-1; the rest ride -m slow.
+# ---------------------------------------------------------------------------
+
+
+def _family_ledger(family):
+    from mpi4dl_tpu.analysis.contracts.engines import build_engine
+
+    step, args = build_engine(family)
+    # Fresh compile: the persistent cache could alias a scope-less build
+    # (obs/hbm.py caveat).
+    cache_dir = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    try:
+        compiled = step.lower(*args).compile()
+    finally:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    text = compiled.as_text()
+    return overlap.overlap_ledger(text, device=jax.devices()[0]), text
+
+
+def _assert_family_ledger(family):
+    led, text = _family_ledger(family)
+    # >=90% of collective bytes land in named scopes (the acceptance gate).
+    assert led["attributed_bytes_frac"] >= 0.9, (
+        family, led["attributed_bytes_frac"])
+    # The ledger agrees with the flat per-class accounting: same op count,
+    # same bytes.
+    flat = hlo_collective_stats(text)
+    t = led["totals"]
+    assert t["async_pairs"] + t["sync"] == flat["total_count"], (
+        family, t, flat["total_count"])
+    assert t["bytes"] == flat["total_bytes"], (family, t)
+    # Conservation: every wire millisecond is either hidden or exposed.
+    assert t["hidden_ms"] + t["exposed_ms"] >= t["wire_ms"] - 1e-6
+    # The structural projection covers the same ops.
+    s = overlap.structural_overlap(text)
+    assert s["totals"]["bytes"] == t["bytes"]
+    assert s["totals"]["sync"] == t["sync"]
+
+
+def test_ledger_lp_family(devices8):
+    _assert_family_ledger("lp")
+
+
+def test_ledger_sp_family(devices8):
+    _assert_family_ledger("sp")
+
+
+@pytest.mark.slow
+def test_ledger_gems_family(devices8):
+    _assert_family_ledger("gems")
+
+
+@pytest.mark.slow
+def test_ledger_gems_sp_family(devices8):
+    _assert_family_ledger("gems_sp")
+
+
+@pytest.mark.slow
+def test_ledger_1f1b_schedule(devices8):
+    _assert_family_ledger("sp_1f1b")
+
+
+def test_all_families_golden_attribution():
+    """The acceptance gate across ALL 8 engine families without paying 8
+    compiles: the checked-in contract goldens carry the structural overlap
+    section, and >=90% of every family's collective bytes must land in
+    named scopes (unscoped wire would rot every ledger this PR adds)."""
+    import glob
+
+    from mpi4dl_tpu.analysis.contracts.__main__ import default_contracts_dir
+    from mpi4dl_tpu.analysis.contracts.engines import ENGINE_FAMILIES
+
+    paths = sorted(glob.glob(os.path.join(default_contracts_dir(), "*.json")))
+    families = {os.path.splitext(os.path.basename(p))[0] for p in paths}
+    assert families == set(ENGINE_FAMILIES), families
+    for path in paths:
+        with open(path, "r", encoding="utf-8") as fh:
+            contract = json.load(fh)
+        ov = contract["overlap"]
+        total = ov["totals"]["bytes"]
+        assert total > 0, path
+        unscoped = sum(
+            e["bytes"]
+            for scope, ops in ov["per_scope"].items() if scope == "<unscoped>"
+            for e in ops.values()
+        )
+        assert 1 - unscoped / total >= 0.9, (path, unscoped, total)
+        # Bytes conservation: the per-scope tree sums to the totals.
+        assert sum(
+            e["bytes"] for ops in ov["per_scope"].values()
+            for e in ops.values()
+        ) == total, path
+
+
+# ---------------------------------------------------------------------------
+# mem_probe --overlap CLI: ledger emitted per row, overlap RunLog record,
+# --require-hidden-frac gate (on the CPU backend every collective is sync,
+# so a positive hidden-frac requirement must fail and 0.0 must pass).
+# ---------------------------------------------------------------------------
+
+
+def test_mem_probe_overlap_cli(devices8, tmp_path, capsys):
+    from benchmarks import mem_probe
+
+    out_path = tmp_path / "probe.json"
+    rc = mem_probe.main([
+        "--family", "lp", "--schedule", "gpipe", "--arch", "resnet",
+        "--image-size", "32", "--num-layers", "11", "--num-filters", "16",
+        "--batch", "4", "--split-size", "2", "--parts", "2",
+        "--overlap", "--require-hidden-frac", "0.5",
+        "--telemetry-dir", str(tmp_path / "t"), "--out", str(out_path),
+    ])
+    # CPU backend compiles every collective sync: hidden 0% < 0.5 -> gate 1.
+    assert rc == 1
+    art = json.loads(out_path.read_text())
+    led = art["schedules"]["gpipe"]["overlap"]
+    assert led["totals"]["sync"] > 0
+    assert led["totals"]["async_pairs"] == 0
+    assert led["hidden_frac"] == 0.0
+    assert led["attributed_bytes_frac"] >= 0.9
+    # The RunLog carries the overlap record and the report renders the
+    # wire line.
+    from mpi4dl_tpu.obs import read_runlog
+    from mpi4dl_tpu.obs.report import render_run
+
+    runs = list((tmp_path / "t").glob("*.jsonl"))
+    assert len(runs) == 1
+    kinds = {r.get("kind") for r in read_runlog(str(runs[0]))}
+    assert "overlap" in kinds
+    text = render_run(str(runs[0]))
+    assert "wire [lp/gpipe]:" in text
+    assert "sync" in text
+    capsys.readouterr()
+
+
+def test_mem_probe_overlap_flag_validation(capsys):
+    from benchmarks import mem_probe
+
+    # --require-hidden-frac without --overlap is a usage error (no compile).
+    assert mem_probe.main([
+        "--family", "lp", "--require-hidden-frac", "0.5",
+    ]) == 2
+    assert "--require-hidden-frac needs --overlap" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# obs report --compare: exposed_wire_ms regressions gate like peak HBM.
+# ---------------------------------------------------------------------------
+
+
+def _write_overlap_run(path, exposed_ms):
+    from mpi4dl_tpu.obs import RunLog
+
+    rl = RunLog(str(path))
+    rl.write_meta(config={"model": "resnet"}, family="lp")
+    rl.write(
+        "overlap",
+        totals={"bytes": 1_000_000, "wire_ms": exposed_ms + 1.0,
+                "hidden_ms": 1.0, "exposed_ms": exposed_ms,
+                "async_pairs": 2, "sync": 1},
+        hidden_frac=1.0 / (exposed_ms + 1.0),
+        simulated_step_ms=10.0 + exposed_ms,
+        rows=[],
+    )
+    rl.close()
+    return str(path)
+
+
+def test_compare_exposed_wire_regression(tmp_path):
+    a = _write_overlap_run(tmp_path / "a.jsonl", 1.0)
+    b = _write_overlap_run(tmp_path / "b.jsonl", 2.0)
+    text, breaches = compare_runs(a, b, threshold_pct=5.0)
+    assert breaches == 1
+    assert "exposed wire ms" in text and "REGRESSION" in text
+    # The good direction (less exposed wire) passes.
+    _, breaches = compare_runs(b, a, threshold_pct=5.0)
+    assert breaches == 0
+    # Identical runs clean.
+    _, breaches = compare_runs(a, a, threshold_pct=0.1)
+    assert breaches == 0
+
+
+def test_obs_overlap_cli_hlo_dump(tmp_path, capsys):
+    from mpi4dl_tpu.obs.__main__ import main
+
+    dump = tmp_path / "mod.txt"
+    dump.write_text(_PARTIAL)
+    assert main(["overlap", "--hlo", str(dump)]) == 0
+    out = capsys.readouterr().out
+    assert "junction_gather" in out
+    # JSON mode round-trips.
+    out_path = tmp_path / "ledger.json"
+    assert main(["overlap", "--hlo", str(dump), "--json",
+                 "--out", str(out_path)]) == 0
+    capsys.readouterr()
+    payload = json.loads(out_path.read_text())
+    led = payload[str(dump)]
+    assert led["totals"]["async_pairs"] == 1
+    # Usage errors: neither/both sources, unknown family.
+    assert main(["overlap"]) == 2
+    assert main(["overlap", "--hlo", str(dump), "--families", "lp"]) == 2
+    assert main(["overlap", "--families", "bogus"]) == 2
+    capsys.readouterr()
