@@ -110,12 +110,20 @@ def build_train(cfg: ParallelConfig, family: str, mesh):
     from mpi4dl_tpu.models import build_model
     from mpi4dl_tpu.train import Optimizer, TrainState
 
+    from mpi4dl_tpu.quant import QuantPolicy
+
     model = build_model(cfg)
     params, _ = model.init(jax.random.key(cfg.seed))
     opt = Optimizer(cfg.optimizer, lr=cfg.lr, momentum=cfg.momentum)
     dp = cfg.data_parallel
     dtype = cfg.compute_dtype
     pdtype = cfg.param_dtype
+    # Quantized-collective policy (None = off = bit-identical engines);
+    # the MPI4DL_QUANT_COLLECTIVES hatch overrides the --quant flag.
+    quant = QuantPolicy.resolve(cfg.quant_collectives)
+    if quant is not None:
+        print(f"note: quantized collectives on: {quant.spec()}",
+              file=sys.stderr)
     if cfg.precision == "bf_16_all":
         # bf_16_all: parameters stored bf16 as well (reference parser.py
         # precision vocabulary); fp32 update arithmetic lives in Optimizer.
@@ -155,7 +163,7 @@ def build_train(cfg: ParallelConfig, family: str, mesh):
         step = make_pipeline_train_step(
             part, opt, mesh, cfg.parts, compute_dtype=dtype, remat=cfg.remat,
             from_probs=from_probs, with_data_axis=dp > 1, donate=True,
-            schedule=cfg.schedule,
+            schedule=cfg.schedule, quant=quant,
         )
         state = init_pipeline_state(part, params, opt, mesh)
         return (
@@ -182,7 +190,7 @@ def build_train(cfg: ParallelConfig, family: str, mesh):
         step = make_gems_train_step(
             part, opt, mesh, cfg.parts, times=cfg.times, compute_dtype=dtype,
             remat=cfg.remat, from_probs=from_probs, with_data_axis=dp > 1,
-            donate=True, schedule=cfg.schedule,
+            donate=True, schedule=cfg.schedule, quant=quant,
         )
         state = init_pipeline_state(part, params, opt, mesh)
         return (
@@ -205,7 +213,7 @@ def build_train(cfg: ParallelConfig, family: str, mesh):
             model, opt, mesh, sp, parts=cfg.parts, with_data_axis=dp > 1,
             compute_dtype=dtype, from_probs=from_probs,
             spatial_until=model.spatial_until, junction=junction,
-            levels=levels, local_dp=local_dp, donate=True,
+            levels=levels, local_dp=local_dp, donate=True, quant=quant,
         )
         state = TrainState.create(params, opt)
         return step, state, (lambda s: s.params), cfg.batch_size * dp
@@ -229,13 +237,13 @@ def build_train(cfg: ParallelConfig, family: str, mesh):
         step = make_sp_gems_train_step(
             spp, opt, mesh, cfg.parts, times=cfg.times, compute_dtype=dtype,
             remat=cfg.remat, from_probs=from_probs, with_data_axis=dp > 1,
-            donate=True, schedule=cfg.schedule,
+            donate=True, schedule=cfg.schedule, quant=quant,
         )
     else:
         step = make_sp_pipeline_train_step(
             spp, opt, mesh, cfg.parts, compute_dtype=dtype, remat=cfg.remat,
             from_probs=from_probs, with_data_axis=dp > 1, donate=True,
-            schedule=cfg.schedule,
+            schedule=cfg.schedule, quant=quant,
         )
     state = init_sp_pipeline_state(spp, params, opt, mesh)
     return (

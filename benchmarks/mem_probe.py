@@ -187,6 +187,7 @@ def _probe_family(args) -> dict:
             num_layers=args.num_layers,
             num_filters=args.num_filters,
             num_classes=args.num_classes,
+            quant_collectives=args.quant,
         )
         spec = (
             MeshSpec.from_config(cfg)
@@ -296,6 +297,8 @@ def _sweep_junction(args) -> dict:
                 for d in s:
                     n *= d
                 spatial_mb += n * 4 / g / 2**20
+        from mpi4dl_tpu.quant import QuantPolicy
+
         spp = SPPipeline.build(model, params, S, sp, microbatch=micro,
                                junction="gather")
         step = make_sp_pipeline_train_step(
@@ -303,6 +306,7 @@ def _sweep_junction(args) -> dict:
             remat=args.remat != "none", schedule=(
                 args.schedule if args.schedule != "both" else "gpipe"
             ),
+            quant=QuantPolicy.resolve(args.quant),
         )
         state = init_sp_pipeline_state(spp, params, opt, mesh)
         t0 = time.perf_counter()
@@ -495,6 +499,11 @@ def main(argv=None) -> int:
     p.add_argument("--spatial-size", type=int, default=1)
     p.add_argument("--num-spatial-parts", type=int, default=2)
     p.add_argument("--num-classes", type=int, default=10)
+    p.add_argument("--quant", default="off", metavar="SPEC",
+                   help="quantized-collective policy for the probed engines "
+                        "(off | int8|fp8|int4 | per-class spec; "
+                        "docs/quantization.md) — pair with --overlap to "
+                        "read the quantized wire per rung")
     p.add_argument("--telemetry-dir", default=None,
                    help="mirror the result into a RunLog JSONL as a "
                         "mem_probe record (docs/observability.md)")

@@ -64,8 +64,22 @@ def main(argv=None) -> int:
                    help="mirror the artifact into a RunLog JSONL "
                         "(readiness + hbm + timeline records; render with "
                         "`python -m mpi4dl_tpu.obs report`)")
+    p.add_argument("--quant", default="off", metavar="SPEC",
+                   help="quantized-collective policy (off | int8|fp8|int4 | "
+                        "per-class spec; docs/quantization.md) — the "
+                        "tentpole's wire-shrink measured at the real shapes")
+    p.add_argument("--require-wire-gb", type=float, default=None,
+                   help="with --attribute: exit 1 if the overlap ledger's "
+                        "total wire exceeds this many GB/step (the "
+                        "quant-contract CI gate: <= 18 GB at 8192² with "
+                        "quantization on, vs the 31.0 GB raw baseline)")
     p.add_argument("--out", default=None)
     args = p.parse_args(argv)
+    if args.require_wire_gb is not None and not args.attribute:
+        # The gate reads the overlap ledger, which only exists under
+        # --attribute; a silent no-op here would pass the CI gate vacuously.
+        p.error("--require-wire-gb needs --attribute (the gate reads the "
+                "overlap ledger)")
 
     n_dev = args.tiles * args.tiles * args.stages
     import jax
@@ -91,6 +105,9 @@ def main(argv=None) -> int:
     )
     from mpi4dl_tpu.train import Optimizer
 
+    from mpi4dl_tpu.quant import QuantPolicy
+
+    quant = QuantPolicy.resolve(args.quant)
     px, t, S = args.image_size, args.tiles, args.stages
     model = amoebanetd(
         (1, px, px, 3), num_classes=1000,
@@ -132,7 +149,7 @@ def main(argv=None) -> int:
                            junction="gather")
     step = make_sp_pipeline_train_step(
         spp, opt, mesh, parts=args.parts, compute_dtype=jnp.bfloat16,
-        remat=True, donate=True, schedule=args.schedule,
+        remat=True, donate=True, schedule=args.schedule, quant=quant,
     )
     state = init_sp_pipeline_state(spp, params, opt, mesh)
     x = jnp.zeros((args.parts * 1, px, px, 3), jnp.bfloat16)
@@ -174,6 +191,7 @@ def main(argv=None) -> int:
             "parts": args.parts, "schedule": args.schedule,
             "devices": n_dev,
             "model": f"amoebanetd({args.num_layers},{args.num_filters})",
+            "quant": quant.spec() if quant else "off",
         },
         "compile_seconds": round(compile_s, 1),
         "memory_analysis": mem,
@@ -224,6 +242,9 @@ def main(argv=None) -> int:
         t_led = ledger["totals"]
         out["overlap_rollup"] = {
             "wire_gb": round(t_led["bytes"] / 2**30, 3),
+            "quantized_gb": round(
+                t_led.get("quantized_bytes", 0) / 2**30, 3
+            ),
             "exposed_ms": t_led["exposed_ms"],
             "hidden_ms": t_led["hidden_ms"],
             "hidden_frac": ledger["hidden_frac"],
@@ -233,12 +254,31 @@ def main(argv=None) -> int:
             "by_class": {
                 cls: {"exposed_ms": b["exposed_ms"],
                       "hidden_ms": b["hidden_ms"],
-                      "gb": round(b["bytes"] / 2**30, 3)}
+                      "gb": round(b["bytes"] / 2**30, 3),
+                      "quantized_gb": round(
+                          b.get("quantized_bytes", 0) / 2**30, 3)}
                 for cls, b in ledger["by_class"].items()
             },
         }
         print(format_breakdown(breakdown), file=sys.stderr)
         print(format_ledger(ledger), file=sys.stderr)
+        if args.require_wire_gb is not None:
+            wire_gb = out["overlap_rollup"]["wire_gb"]
+            if wire_gb > args.require_wire_gb:
+                print(
+                    f"[readiness] WIRE GATE FAILED: {wire_gb} GB/step > "
+                    f"--require-wire-gb {args.require_wire_gb}",
+                    file=sys.stderr,
+                )
+                out["wire_gate"] = {"limit_gb": args.require_wire_gb,
+                                    "ok": False}
+            else:
+                print(
+                    f"[readiness] wire gate ok: {wire_gb} GB/step <= "
+                    f"{args.require_wire_gb}", file=sys.stderr,
+                )
+                out["wire_gate"] = {"limit_gb": args.require_wire_gb,
+                                    "ok": True}
 
     line = json.dumps(out)
     print(line)
@@ -261,6 +301,8 @@ def main(argv=None) -> int:
         runlog.close()
         print(f"[readiness] telemetry written to {runlog.path}",
               file=sys.stderr)
+    if not out.get("wire_gate", {}).get("ok", True):
+        return 1
     return 0
 
 

@@ -61,6 +61,16 @@ def main(argv=None) -> int:
                          "(plus meta mismatches); the overlap-contract CI "
                          "job gates on --section overlap so overlap "
                          "regressions fail with a focused report")
+    ap.add_argument("--quant", metavar="SPEC", default=None,
+                    help="extract with the quantized-collective policy on "
+                         "(e.g. int8); goldens default to "
+                         "<repo>/contracts/quant_<mode>/ and every family "
+                         "additionally gets the byte-ratio gate against the "
+                         "RAW goldens (--max-ratio)")
+    ap.add_argument("--max-ratio", type=float, default=0.55,
+                    help="with --quant: max quantized/raw contract-byte "
+                         "ratio per gated wire class (junction/respatial/"
+                         "grad); exceeded = exit 1 (default 0.55)")
     args = ap.parse_args(argv)
 
     families = list(ENGINE_FAMILIES)
@@ -77,11 +87,67 @@ def main(argv=None) -> int:
         print(f"contracts: {err}", file=sys.stderr)
         return 2
 
-    directory = args.dir or default_contracts_dir()
+    build = None
+    policy = None
+    if args.quant:
+        from mpi4dl_tpu.analysis.contracts.engines import build_engine
+        from mpi4dl_tpu.quant import QuantPolicy
+
+        try:
+            policy = QuantPolicy.parse(args.quant)
+        except ValueError as e:
+            print(f"contracts: {e}", file=sys.stderr)
+            return 2
+        if policy is None:
+            print("contracts: --quant off is the default contract set; "
+                  "drop the flag", file=sys.stderr)
+            return 2
+        build = lambda f: build_engine(f, quant=policy)  # noqa: E731
+
+    raw_directory = default_contracts_dir()
+    directory = args.dir or (
+        os.path.join(raw_directory,
+                     "quant_" + args.quant.replace(",", "_").replace("=", "-"))
+        if args.quant else raw_directory
+    )
     report: Dict[str, List[dict]] = {}
+    ratio_report: Dict[str, dict] = {}
     rc = 0
     for family in families:
-        current = extract_contract(family)
+        current = extract_contract(family, build=build)
+        if policy is not None:
+            # Byte-ratio gate vs the RAW golden (the tentpole's acceptance
+            # criterion: junction/respatial/grad contract bytes <=
+            # max_ratio x raw on every family; vacuous where raw is 0).
+            from mpi4dl_tpu.analysis.contracts.diff import (
+                quant_byte_ratios,
+                render_ratio_report,
+            )
+
+            raw_path = golden_path(raw_directory, family)
+            if os.path.exists(raw_path):
+                with open(raw_path, "r", encoding="utf-8") as fh:
+                    raw_golden = json.load(fh)
+                rows, breaches = quant_byte_ratios(
+                    raw_golden, current, args.max_ratio
+                )
+                ratio_report[family] = {"rows": rows, "breaches": breaches}
+                if not args.json:
+                    print(render_ratio_report(family, rows, breaches,
+                                              args.max_ratio))
+                if breaches:
+                    rc = 1
+            else:
+                # A missing raw golden must not pass the ratio gate
+                # vacuously — the "<= max_ratio x raw on every family"
+                # criterion would be unenforced with no signal.
+                ratio_report[family] = {
+                    "rows": [], "breaches": [f"no raw golden at {raw_path}"]
+                }
+                print(f"quant ratio gate FAILED for {family}: no raw "
+                      f"golden at {raw_path} (regenerate the raw contract "
+                      "set first)", file=sys.stderr)
+                rc = 1
         path = golden_path(directory, family)
         if args.update:
             os.makedirs(directory, exist_ok=True)
@@ -119,7 +185,11 @@ def main(argv=None) -> int:
                     "version skew, not a code change"
                 )
 
-    payload = json.dumps({"drift": report}, indent=2, sort_keys=True)
+    payload = json.dumps(
+        {"drift": report, **({"quant_ratio": ratio_report}
+                             if ratio_report else {})},
+        indent=2, sort_keys=True,
+    )
     if args.json:
         print(payload)
     if args.out:

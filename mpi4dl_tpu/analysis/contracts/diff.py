@@ -22,7 +22,7 @@ machine-checkable (the CI job uploads it as an artifact on failure):
 
 from __future__ import annotations
 
-from typing import Dict, List
+from typing import Dict, List, Tuple
 
 
 def _counted(d: dict, *path) -> Dict[str, int]:
@@ -142,6 +142,78 @@ def _diff_overlap(golden: dict, current: dict) -> List[dict]:
                 rec[f"{f}_current"] = c[f]
             out.append(rec)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Quantized-contract byte-ratio gate (ISSUE 10)
+# ---------------------------------------------------------------------------
+
+# The wire classes the --quant ratio gate enforces (the tentpole's
+# "junction + respatial + grad-reduce contract bytes <= max_ratio x raw").
+# handoff is reported but not gated — it is quantized opportunistically
+# and absent from the acceptance criteria's class list.  NOTE: the frozen
+# contract families run a single spatial level, so respatial is vacuous
+# HERE; its non-vacuous enforcement is the lowered multilevel-engine
+# ratio test (tests/test_quant.py::
+# test_respatial_ratio_non_vacuous_on_multilevel_engine).
+QUANT_GATED_CLASSES = ("junction", "respatial", "grad")
+
+
+def quant_class_bytes(contract: dict) -> Dict[str, int]:
+    """Per-quant-class byte sums over a contract's per-scope collective
+    ledger (classes from mpi4dl_tpu.quant.policy.HOT_SCOPE_PATTERNS)."""
+    from mpi4dl_tpu.quant.policy import scope_quant_class
+
+    out: Dict[str, int] = {}
+    for scope, ops in (contract.get("collectives") or {}).items():
+        cls = scope_quant_class(scope)
+        if cls is None:
+            continue
+        out[cls] = out.get(cls, 0) + sum(
+            v.get("bytes", 0) for v in ops.values()
+        )
+    return out
+
+
+def quant_byte_ratios(raw: dict, quant: dict, max_ratio: float
+                      ) -> Tuple[List[dict], List[str]]:
+    """Compare a quantized contract's hot-class bytes against the RAW
+    golden's: returns ``(rows, breach_lines)``.  A gated class whose
+    quantized bytes exceed ``max_ratio`` x the raw bytes breaches; classes
+    the family doesn't exercise (raw == 0 — e.g. lp has no junction) are
+    reported as n/a and pass vacuously."""
+    rb, qb = quant_class_bytes(raw), quant_class_bytes(quant)
+    rows: List[dict] = []
+    breaches: List[str] = []
+    for cls in sorted(set(rb) | set(qb)):
+        r, q = rb.get(cls, 0), qb.get(cls, 0)
+        ratio = (q / r) if r else None
+        gated = cls in QUANT_GATED_CLASSES
+        rows.append({"class": cls, "raw_bytes": r, "quant_bytes": q,
+                     "ratio": None if ratio is None else round(ratio, 4),
+                     "gated": gated})
+        if gated and ratio is not None and ratio > max_ratio:
+            breaches.append(
+                f"class {cls}: quantized bytes {q} > {max_ratio:g} x raw "
+                f"{r} (ratio {ratio:.3f})"
+            )
+    return rows, breaches
+
+
+def render_ratio_report(engine: str, rows: List[dict],
+                        breaches: List[str], max_ratio: float) -> str:
+    lines = [f"quant byte ratio: engine {engine} (gate <= {max_ratio:g}x "
+             f"on {'/'.join(QUANT_GATED_CLASSES)})"]
+    for r in rows:
+        ratio = "n/a" if r["ratio"] is None else f"{r['ratio']:.3f}x"
+        mark = "" if r["gated"] else "  (reported, not gated)"
+        lines.append(
+            f"  {r['class']:<10} raw {r['raw_bytes']:>12} -> quant "
+            f"{r['quant_bytes']:>12}  {ratio}{mark}"
+        )
+    for b in breaches:
+        lines.append(f"  BREACH: {b}")
+    return "\n".join(lines)
 
 
 def _fmt_delta(golden: int, current: int) -> str:

@@ -54,12 +54,18 @@ def required_devices(family: str) -> int:
     )
 
 
-def build_engine(family: str):
+def build_engine(family: str, quant=None):
     """Build the family's canonical train step on the virtual mesh.
 
     Returns ``(step, args)`` where ``step`` is the jitted train step and
     ``args`` the abstract-ready argument tuple — ``step.lower(*args)`` is
     the only thing callers do with it (contracts never execute).
+
+    ``quant`` (Optional[QuantPolicy]): build the SAME frozen configuration
+    with quantized collectives on — the ``--quant`` contract set
+    (goldens under ``contracts/quant_<mode>/``) and the byte-ratio gate
+    extract through this; the default ``None`` build must stay
+    bit-identical to the raw goldens.
     """
     import jax
     import jax.numpy as jnp
@@ -96,12 +102,13 @@ def build_engine(family: str):
             from mpi4dl_tpu.parallel.pipeline import make_pipeline_train_step
 
             step = make_pipeline_train_step(part, opt, mesh, parts=_PARTS,
-                                            schedule=schedule)
+                                            schedule=schedule, quant=quant)
         else:
             from mpi4dl_tpu.parallel.gems import make_gems_train_step
 
             step = make_gems_train_step(part, opt, mesh, parts=_PARTS,
-                                        times=1, schedule=schedule)
+                                        times=1, schedule=schedule,
+                                        quant=quant)
         state = init_pipeline_state(part, params, opt, mesh)
         return step, (state, x, y)
 
@@ -123,9 +130,9 @@ def build_engine(family: str):
                            junction="gather")
     if family == "sp":
         step = make_sp_pipeline_train_step(spp, opt, mesh, parts=_PARTS,
-                                           schedule=schedule)
+                                           schedule=schedule, quant=quant)
     else:
         step = make_sp_gems_train_step(spp, opt, mesh, parts=_PARTS, times=1,
-                                       schedule=schedule)
+                                       schedule=schedule, quant=quant)
     state = init_sp_pipeline_state(spp, params, opt, mesh)
     return step, (state, x, y)

@@ -10,6 +10,7 @@ from mpi4dl_tpu.analysis.rules_collective import RULE as _collective
 from mpi4dl_tpu.analysis.rules_dtype import RULE as _dtype
 from mpi4dl_tpu.analysis.rules_env import RULE as _env
 from mpi4dl_tpu.analysis.rules_print import RULE as _print
+from mpi4dl_tpu.analysis.rules_quant import RULE as _quant
 from mpi4dl_tpu.analysis.rules_retrace import RULE as _retrace
 from mpi4dl_tpu.analysis.rules_scope import RULE as _scope
 from mpi4dl_tpu.analysis.rules_swallow import RULE as _swallow
@@ -26,6 +27,7 @@ RULE_TABLE: List[Rule] = [
     _swallow,
     _thread,
     _scope,
+    _quant,
 ]
 
 RULES_BY_NAME: Dict[str, Rule] = {r.name: r for r in RULE_TABLE}

@@ -1,0 +1,137 @@
+"""Rule ``unquantized-collective`` (rule 11): collectives on the hot wire
+list must offer the quantized path.
+
+The quantized-collective layer (mpi4dl_tpu/quant, docs/quantization.md)
+halves the 8K step's wire by encoding the payload of the junction /
+respatial / grad-reduce / handoff collectives.  The win only holds while
+every hot call site stays routed through the quant layer: a refactor that
+re-introduces a bare ``lax.all_gather`` under ``scope("junction_gather")``
+silently restores full-precision wire with no test failing — the contract
+ratio gate would catch it one CI tier later, with a byte diff instead of a
+source line.  This rule fails the build at the source level.
+
+Scope: files under ``mpi4dl_tpu/parallel/`` (the engines — ops/ halo
+kernels are latency-bound, 1.4% of bytes, deliberately not hot).  A
+``jax.lax`` collective call lexically inside a ``with scope(...)`` block
+whose literal name matches a hot pattern
+(:data:`mpi4dl_tpu.quant.policy.HOT_SCOPE_PATTERNS`: junction*,
+stage_lineup, respatial*, grad_reduce, stats_reduce, stage_handoff,
+cot_handoff) must share that WITH-BLOCK with a reference to the quant
+layer (a ``quant``-named guard or a ``quantized_*`` call) — i.e. the
+exact collective must be the policy-off branch of a quant-aware site,
+checked per block so a bare collective added to an already-quant-aware
+engine function still trips the rule.
+Exact-by-design sites (e.g. the loss_reduce scalar psums — not hot — or a
+justified exact transpose) carry ``# analysis: ok(unquantized-collective)``
+with a comment saying why.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from mpi4dl_tpu.analysis.core import Project, Rule, Violation
+from mpi4dl_tpu.analysis.rules_scope import _COLLECTIVES, _SCOPE_CALLEES
+from mpi4dl_tpu.quant.policy import scope_quant_class
+
+
+def _is_target(rel: str) -> bool:
+    return "mpi4dl_tpu/parallel/" in f"/{rel}"
+
+
+def _literal_prefix(node: ast.expr) -> Optional[str]:
+    """The literal text of a scope-name argument: a str constant, or the
+    constant parts of an f-string (enough to match the hot patterns —
+    every hot scope's class-determining token is literal)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = [v.value for v in node.values
+                 if isinstance(v, ast.Constant) and isinstance(v.value, str)]
+        return "".join(parts) if parts else None
+    return None
+
+
+class UnquantizedCollectiveRule(Rule):
+    name = "unquantized-collective"
+    description = (
+        "bare jax.lax collective under a hot-wire obs.scope (junction/"
+        "respatial/grad_reduce/stats_reduce/handoff) in a function with no "
+        "quant-layer path — the quantized-collective win silently degrades; "
+        "route through mpi4dl_tpu.quant or pragma a justified exact site."
+    )
+
+    def check(self, project: Project) -> List[Violation]:
+        out: List[Violation] = []
+        for src in project.files:
+            if not _is_target(src.rel):
+                continue
+            # Spans of `with scope("<hot name>")` blocks.
+            hot_spans: List[Tuple[int, int, str]] = []
+            for w in src.nodes(ast.With):
+                for item in w.items:
+                    ctx = item.context_expr
+                    if not isinstance(ctx, ast.Call) or not ctx.args:
+                        continue
+                    resolved = src.resolve(ctx.func) or ""
+                    if not (resolved in _SCOPE_CALLEES
+                            or resolved.endswith(".named_scope")):
+                        continue
+                    name = _literal_prefix(ctx.args[0])
+                    cls = scope_quant_class(name or "")
+                    if cls:
+                        hot_spans.append(
+                            (w.lineno, getattr(w, "end_lineno", w.lineno),
+                             name)
+                        )
+            if not hot_spans:
+                continue
+            # Lines that reference the quant layer (a `quant` name/guard,
+            # a quantized_* helper, a mpi4dl_tpu.quant.* attribute).  The
+            # awareness check is PER HOT WITH-BLOCK, not per function: the
+            # big engine functions all reference quant somewhere, so a
+            # function-granular check would wave through a new bare
+            # hot-wire collective added to them — exactly the regression
+            # this rule exists to catch.
+            quant_lines: List[int] = []
+            for n in src.nodes(ast.Name):
+                if "quant" in n.id.lower():
+                    quant_lines.append(n.lineno)
+            for n in src.nodes(ast.Attribute):
+                if "quant" in (n.attr or "").lower():
+                    quant_lines.append(n.lineno)
+            for node in src.nodes(ast.Call):
+                resolved = src.resolve(node.func) or ""
+                parts = resolved.split(".")
+                if parts[-1] not in _COLLECTIVES:
+                    continue
+                if not (resolved.startswith("jax.lax.")
+                        or resolved.startswith("lax.")):
+                    continue
+                span = next(
+                    ((a, b, name) for a, b, name in hot_spans
+                     if a <= node.lineno <= b), None
+                )
+                if span is None:
+                    continue
+                a, b, hot = span
+                if any(a <= ln <= b for ln in quant_lines):
+                    continue
+                out.append(
+                    Violation(
+                        self.name,
+                        src.rel,
+                        node.lineno,
+                        f"{parts[-1]} under hot scope {hot!r} with no "
+                        "quant-layer path in the scope block — the "
+                        "quantized-collective wire win silently degrades "
+                        "(docs/quantization.md); route through "
+                        "mpi4dl_tpu.quant or pragma a justified exact "
+                        "site with `# analysis: ok(unquantized-collective)`",
+                    )
+                )
+        return out
+
+
+RULE = UnquantizedCollectiveRule()

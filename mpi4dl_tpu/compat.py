@@ -47,6 +47,12 @@ except ImportError:  # jax 0.4.x
         stacklevel=2,
     )
 
+# Public version guard: True on legacy jax (0.4.x line — no top-level
+# jax.shard_map, check_rep=False AD, pcast no-op).  The engine exactness
+# tests skipif on this (tests/*: the documented old-jax failures), so they
+# auto-unskip on any vma-aware jax.
+LEGACY_JAX = _LEGACY
+
 
 def shard_map(f, *, mesh, in_specs, out_specs, **kwargs):
     # normalize the checker kwarg across the rename (check_rep -> check_vma)

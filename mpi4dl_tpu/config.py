@@ -77,6 +77,17 @@ HATCHES: Dict[str, Hatch] = {
               "1 = disable obs trace scopes (jax.named_scope semantic names "
               "in traces/HLO) and host step annotations — pristine A/B "
               "compiles."),
+        Hatch("MPI4DL_QUANT_COLLECTIVES", "<unset>",
+              "Quantized-collective policy override (wins over --quant when "
+              "set): `off`, one mode for every class (`int8`|`fp8`|`int4`), "
+              "or per-class `junction=int4,respatial=int8,grad=int8,"
+              "handoff=int8[,block=N]` — per-block-scaled payloads on the "
+              "junction/respatial/grad/handoff wire classes "
+              "(docs/quantization.md)."),
+        Hatch("MPI4DL_NO_RESPATIAL_FAST", "0",
+              "1 = disable the gather-free respatial fast paths (refine = "
+              "local slice, coarsen = intra-group ring) and keep the legacy "
+              "full-gather + slice reshard for A/B comparison."),
         Hatch("MPI4DL_FAULT", "<unset>",
               "Deterministic fault injection: `<kind>@<step>[:arg]` with "
               "kind in nan_loss|nan_batch|raise|sigterm|corrupt_ckpt|"
@@ -171,6 +182,11 @@ class ParallelConfig:
     # programs (PERF_NOTES r4, benchmark_d2_step.py).  --pallas-conv is the
     # explicit opt-in; resolved by resolve_pallas_conv().
     pallas_conv: Optional[bool] = None
+    # Quantized-collective policy spec ("off" | "int8" | "fp8" | "int4" |
+    # per-class "junction=int4,grad=int8[,block=N]"); resolved by
+    # mpi4dl_tpu.quant.QuantPolicy.resolve (the MPI4DL_QUANT_COLLECTIVES
+    # hatch overrides).  Off is bit-identical to the unquantized engines.
+    quant_collectives: str = "off"
     verbose: bool = False  # debug logging (reference parser.py --verbose)
     checkpoint_dir: Optional[str] = None
     seed: int = 0
@@ -218,6 +234,11 @@ class ParallelConfig:
         assert self.batch_size % self.parts == 0, "batch must divide into parts"
         if self.balance is not None:
             assert len(self.balance) == self.split_size
+        # Fail fast on a malformed quant spec (raises ValueError with the
+        # offending token; the hatch override is resolved at build time).
+        from mpi4dl_tpu.quant.policy import QuantPolicy
+
+        QuantPolicy.parse(self.quant_collectives)
 
 
 def is_tpu_backend() -> bool:
@@ -298,6 +319,12 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-pallas-conv", action="store_const", const=False,
                    dest="pallas_conv",
                    help="keep all convs on XLA even on TPU")
+    p.add_argument("--quant", dest="quant_collectives", type=str,
+                   default="off", metavar="SPEC",
+                   help="quantized-collective policy: off (default, "
+                        "bit-identical), int8|fp8|int4 for every hot class, "
+                        "or per-class junction=...,respatial=...,grad=...,"
+                        "handoff=...[,block=N] (docs/quantization.md)")
     p.add_argument("--checkpoint-dir", type=str, default=None)
     p.add_argument("--seed", type=int, default=0)
     return p
@@ -341,6 +368,7 @@ def config_from_args(args: argparse.Namespace) -> ParallelConfig:
         lr=args.lr,
         remat=not args.no_remat,
         pallas_conv=args.pallas_conv,
+        quant_collectives=getattr(args, "quant_collectives", "off"),
         verbose=getattr(args, "verbose", False),
         checkpoint_dir=args.checkpoint_dir,
         seed=args.seed,

@@ -37,6 +37,7 @@ STABLEHLO_COLLECTIVES = (
 _DTYPE_BYTES = {
     "f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4, "pred": 1,
     "s8": 1, "u8": 1, "f64": 8, "s64": 8, "u64": 8,
+    "f8e4m3fn": 1, "f8e5m2": 1,  # quantized fp8 payloads (quant layer)
 }
 
 
@@ -152,11 +153,15 @@ _FRAMING_COMPONENTS = re.compile(
     r"branch_\d+(?:_fun)?|None)$"
 )
 
-_MLIR_TENSOR_RE = re.compile(r"tensor<(?:([0-9x]+)x)?([a-z][a-z0-9]+)>")
+_MLIR_TENSOR_RE = re.compile(
+    # element type may carry uppercase (f8E4M3FN — the quant layer's fp8)
+    r"tensor<(?:([0-9x]+)x)?([a-z][a-zA-Z0-9]+)>"
+)
 
 _MLIR_DTYPE_BYTES = {
     "f32": 4, "bf16": 2, "f16": 2, "f64": 8, "i1": 1, "i8": 1, "ui8": 1,
     "i16": 2, "ui16": 2, "i32": 4, "ui32": 4, "i64": 8, "ui64": 8,
+    "f8E4M3FN": 1, "f8E5M2": 1,  # quantized fp8 payloads (quant layer)
 }
 
 

@@ -48,6 +48,7 @@ contains zero FLOPs — no cost model, no floats, stable under a pinned jax).
 from __future__ import annotations
 
 import dataclasses
+import re
 from typing import Dict, List, Optional, Set, Tuple
 
 from mpi4dl_tpu.obs.costs import (
@@ -101,6 +102,25 @@ def start_payload_bytes(ins: Instr) -> int:
     return ins.bytes
 
 
+# Sub-f32 payload element types the quant layer puts on the wire
+# (mpi4dl_tpu/quant: int8 / packed int4 ride s8, fp8 rides f8e4m3fn).
+# The f32 scale tensors ride separate collectives and are — honestly —
+# counted as unquantized bytes.
+_QUANT_DTYPES = ("s8", "u8", "s4", "u4", "s2", "u2", "f8")
+_SHAPE_DTYPE = re.compile(r"([a-z][a-z0-9]*)\[")
+
+
+def payload_quantized(ins: Instr) -> bool:
+    """True when every tensor element type of the collective's wire payload
+    is a quantized dtype (the ``quantized_bytes`` ledger column)."""
+    elems = _tuple_elements(ins.shape)
+    shape = elems[1] if len(elems) > 1 else ins.shape
+    dts = [d for d in _SHAPE_DTYPE.findall(shape) if d != "token"]
+    return bool(dts) and all(
+        d in _QUANT_DTYPES or d.startswith("f8") for d in dts
+    )
+
+
 @dataclasses.dataclass
 class WireEvent:
     """One collective's wire accounting in the simulated schedule."""
@@ -113,6 +133,7 @@ class WireEvent:
     sync: bool          # compiled without a start/done split
     window_flops: float  # FLOPs scheduled inside the start..done window
     comp: str           # computation the collective was scheduled in
+    quantized: bool = False  # sub-f32 wire payload (quant layer)
 
 
 @dataclasses.dataclass
@@ -129,6 +150,7 @@ class _Pending:
     bytes: int
     cls: str
     scope: str
+    quantized: bool = False
 
 
 class _ScheduleWalker:
@@ -237,6 +259,7 @@ class _ScheduleWalker:
                 base or ins.opcode == "async-start"
             ):
                 cls, scope, nbytes = base, ins.scope, start_payload_bytes(ins)
+                quantized = payload_quantized(ins)
                 if ins.opcode == "async-start":
                     inner = self._wrapped_collective(ins)
                     if inner is None:
@@ -246,8 +269,10 @@ class _ScheduleWalker:
                     nbytes = (start_payload_bytes(inner)
                               if inner.opcode.endswith("-start")
                               else inner.bytes)
+                    quantized = payload_quantized(inner)
                 pending[ins.name] = _Pending(clock, flops_acc, nbytes,
-                                             cls or "collective", scope)
+                                             cls or "collective", scope,
+                                             quantized)
             elif ins.opcode.endswith("-done") and (
                 base or ins.opcode == "async-done"
             ):
@@ -263,7 +288,7 @@ class _ScheduleWalker:
                     scope=p.scope, cls=p.cls, bytes=p.bytes,
                     wire_ms=wire_ms, hidden_ms=hidden, exposed_ms=exposed,
                     sync=False, window_flops=flops_acc - p.flops_at_issue,
-                    comp=comp,
+                    comp=comp, quantized=p.quantized,
                 ))
             elif base:
                 # Sync collective: no split, the device sits on the whole
@@ -277,6 +302,7 @@ class _ScheduleWalker:
                     scope=ins.scope, cls=base, bytes=ins.bytes,
                     wire_ms=wire_ms, hidden_ms=0.0, exposed_ms=stall,
                     sync=True, window_flops=0.0, comp=comp,
+                    quantized=payload_quantized(ins),
                 ))
             elif ins.opcode in ("convolution", "dot"):
                 fl = instr_flops(ins, ins.raw)
@@ -313,6 +339,7 @@ class _ScheduleWalker:
                 scope=p.scope, cls=p.cls, bytes=p.bytes, wire_ms=wire_ms,
                 hidden_ms=hidden, exposed_ms=exposed, sync=False,
                 window_flops=flops_acc - p.flops_at_issue, comp=comp,
+                quantized=p.quantized,
             ))
         return _CompSim(duration_ms=clock, flops=flops_acc, events=events)
 
@@ -362,9 +389,12 @@ def overlap_ledger(
     labeled nominal constants).  Returns a JSON-ready dict (the ``overlap``
     RunLog record; render with :func:`format_ledger`)::
 
-        rows                per-scope {bytes, wire_ms, hidden_ms,
-                            exposed_ms, async_pairs, sync, classes}
-                            sorted by exposed_ms
+        rows                per-scope {bytes, quantized_bytes, wire_ms,
+                            hidden_ms, exposed_ms, async_pairs, sync,
+                            classes} sorted by exposed_ms
+                            (quantized_bytes = payload riding sub-f32
+                            dtypes, the quant layer's wire; scale tensors
+                            count as raw)
         by_class            the same, rolled up by semantic wire class
         totals              step-level sums + async_pairs/sync counts
         hidden_frac         hidden / wire (None when nothing moves)
@@ -386,11 +416,13 @@ def overlap_ledger(
     events, sim = _events(hlo_text, peak, ici_bw)
 
     def bucket() -> dict:
-        return {"bytes": 0, "wire_ms": 0.0, "hidden_ms": 0.0,
-                "exposed_ms": 0.0, "async_pairs": 0, "sync": 0}
+        return {"bytes": 0, "quantized_bytes": 0, "wire_ms": 0.0,
+                "hidden_ms": 0.0, "exposed_ms": 0.0, "async_pairs": 0,
+                "sync": 0}
 
     def add(b: dict, e: WireEvent) -> None:
         b["bytes"] += e.bytes
+        b["quantized_bytes"] += e.bytes if e.quantized else 0
         b["wire_ms"] += e.wire_ms
         b["hidden_ms"] += e.hidden_ms
         b["exposed_ms"] += e.exposed_ms
@@ -433,6 +465,10 @@ def overlap_ledger(
         "totals": rounded(totals),
         "hidden_frac": (
             round(totals["hidden_ms"] / wire, 4) if wire else None
+        ),
+        "quantized_frac": (
+            round(totals["quantized_bytes"] / totals["bytes"], 4)
+            if totals["bytes"] else None
         ),
         "attributed_bytes_frac": (
             round(attributed / totals["bytes"], 4) if totals["bytes"]
@@ -497,8 +533,11 @@ def format_ledger(ledger: dict, top: int = 12) -> str:
         f"[{ledger['ici_source']}], peak "
         + (f"{ledger['peak_flops']:.3g} FLOP/s [{ledger['peak_source']}])"
            if ledger.get("peak_flops") else "n/a)"),
-        f"wire {_ms(t['wire_ms'])} ms over {t['bytes']} bytes — hidden "
-        f"{_ms(t['hidden_ms'])} ms, exposed {_ms(t['exposed_ms'])} ms"
+        f"wire {_ms(t['wire_ms'])} ms over {t['bytes']} bytes"
+        + (f" ({t['quantized_bytes']} quantized)"
+           if t.get("quantized_bytes") else "")
+        + f" — hidden {_ms(t['hidden_ms'])} ms, exposed "
+        f"{_ms(t['exposed_ms'])} ms"
         + (f" (hidden {hidden_frac:.1%})" if hidden_frac is not None else "")
         + f"; async pairs {t['async_pairs']}, sync {t['sync']}",
         f"simulated step {_ms(ledger['simulated_step_ms'])} ms "

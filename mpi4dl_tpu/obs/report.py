@@ -153,9 +153,12 @@ def render_run(path: str) -> str:
         t = rec.get("totals") or {}
         label = rec.get("label")
         hf = rec.get("hidden_frac")
+        qb = t.get("quantized_bytes") or 0
         lines.append(
             "wire" + (f" [{label}]" if label else "") + ": "
-            f"{_fmt_bytes(t.get('bytes'))}/step — exposed "
+            f"{_fmt_bytes(t.get('bytes'))}/step"
+            + (f" ({_fmt_bytes(qb)} quantized)" if qb else "")
+            + " — exposed "
             f"{t.get('exposed_ms')} ms, hidden {t.get('hidden_ms')} ms"
             + (f" ({hf:.1%} hidden)" if hf is not None else "")
             + f"; async pairs {t.get('async_pairs', 0)}, "
@@ -345,6 +348,32 @@ def _probe_peak_gb(records: List[dict]) -> Optional[float]:
     return None
 
 
+def _overlap_byte_pairs(records: List[dict]) -> List[Tuple[float, float]]:
+    """(total, quantized) wire bytes of every ``overlap`` record — the one
+    scan both wire-byte compare metrics min-reduce over."""
+    return [
+        (float(t["bytes"]), float(t.get("quantized_bytes") or 0))
+        for r in records if r.get("kind") == "overlap"
+        for t in [r.get("totals") or {}] if t.get("bytes") is not None
+    ]
+
+
+def _wire_bytes(records: List[dict]) -> Optional[float]:
+    """Total wire bytes/step from ``overlap`` records (best probed row)."""
+    pairs = _overlap_byte_pairs(records)
+    return min(b for b, _ in pairs) if pairs else None
+
+
+def _raw_wire_bytes(records: List[dict]) -> Optional[float]:
+    """UNQUANTIZED wire bytes/step (total - quantized) — the quantized-vs-
+    raw split as a first-class compare metric: a run that loses its
+    quantized payloads (the quant layer silently off) regresses here even
+    if total bytes barely move.  Records predating the quantized_bytes
+    column report their total (all-raw)."""
+    pairs = _overlap_byte_pairs(records)
+    return min(b - q for b, q in pairs) if pairs else None
+
+
 def _exposed_wire_ms(records: List[dict]) -> Optional[float]:
     """Exposed-wire time from ``overlap`` records (best probed row, like
     the mem_probe peak metric), falling back to the timeline record's
@@ -371,6 +400,8 @@ _COMPARE_METRICS = [
     ("collective bytes/step", "lower", _coll_bytes),
     ("mem_probe peak GB", "lower", _probe_peak_gb),
     ("exposed wire ms", "lower", _exposed_wire_ms),
+    ("wire bytes/step", "lower", _wire_bytes),
+    ("raw (unquantized) wire bytes", "lower", _raw_wire_bytes),
 ]
 
 

@@ -31,7 +31,7 @@ TPU-native re-design (this module):
 from __future__ import annotations
 
 import functools
-from typing import Any, Tuple
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -43,7 +43,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 from mpi4dl_tpu.layer_ctx import ApplyCtx
 from mpi4dl_tpu.obs.scopes import scope
 from mpi4dl_tpu.parallel.partition import StagePartition
-from mpi4dl_tpu.parallel.pipeline import PipelineState
+from mpi4dl_tpu.parallel.pipeline import PipelineState, grad_pmean
+from mpi4dl_tpu.quant.policy import QuantPolicy
 from mpi4dl_tpu.parallel.stage_common import (
     gems_dual_scan,
     make_gems_1f1b_scan,
@@ -71,6 +72,7 @@ def make_gems_train_step(
     bn_stats: bool = True,
     donate: bool = False,
     schedule: str = "gpipe",
+    quant: Optional[QuantPolicy] = None,
 ):
     """Build the GEMS step: x is [2 * times * parts * mb, H, W, C]; the first
     half of each pair flows forward, the second backward.
@@ -78,7 +80,12 @@ def make_gems_train_step(
     ``schedule="1f1b"`` swaps the dual tick loop for its manual-backward
     1F1B counterpart (stage_common.make_gems_1f1b_scan) — the mirror streams
     keep interleaving, with both streams' cotangent ppermutes riding the
-    same ticks as the activations."""
+    same ticks as the activations.
+
+    ``quant``: opt-in quantized-collective policy (docs/quantization.md);
+    both streams' activation/cotangent handoffs and the DP grad/stats
+    pmeans quantize — the gems_mirror ppermute does NOT (it moves
+    parameters)."""
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"unknown schedule {schedule!r}; use 'gpipe' or '1f1b'")
     S = part.num_stages
@@ -98,6 +105,7 @@ def make_gems_train_step(
             part, branches,
             vary_axes=(AXIS_STAGE,) + grad_axes,
             from_probs=from_probs, compute_dtype=compute_dtype,
+            quant=quant,
         )
         if schedule == "1f1b"
         else None
@@ -132,6 +140,7 @@ def make_gems_train_step(
                         vary_axes=(AXIS_STAGE,) + grad_axes,
                         from_probs=from_probs,
                         compute_dtype=compute_dtype,
+                        quant=quant,
                     )
             denom = 2 * times * Pn
             with scope("loss_reduce"):
@@ -152,13 +161,13 @@ def make_gems_train_step(
         )(flat_params)
         if grad_axes:
             with scope("grad_reduce"):
-                grads = lax.pmean(grads, grad_axes)
+                grads = grad_pmean(grads, grad_axes, quant)
         with scope("optimizer_update"):
             new_flat, new_opt = optimizer.update(flat_params, grads, opt_local)
         if with_stats:
             if grad_axes:
                 with scope("stats_reduce"):
-                    stats = lax.pmean(stats, grad_axes)
+                    stats = grad_pmean(stats, grad_axes, quant)
             new_flat = scatter_stage_stats(part, new_flat, stats)
         return (
             new_flat[None],

@@ -35,7 +35,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Any, Tuple
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -58,8 +58,22 @@ from mpi4dl_tpu.parallel.stage_common import (
     stage_opt_specs,
     use_1f1b_cell_remat,
 )
+from mpi4dl_tpu.quant.collectives import quantized_pmean
+from mpi4dl_tpu.quant.policy import QuantPolicy
 from mpi4dl_tpu.train import Optimizer
 from mpi4dl_tpu.mesh import AXIS_DATA, AXIS_STAGE
+
+
+def grad_pmean(x, axes, quant: Optional[QuantPolicy]):  # analysis: ok(unscoped-collective) — callers own the grad_reduce/stats_reduce scopes
+    """The engines' gradient/BN-stats ``pmean``, EQuARX-style-quantized
+    when the policy's ``grad`` class is on (quantized all_to_all → exact
+    f32 dequant-accumulate per shard → quantized all_gather; see
+    quant/collectives.quantized_pmean).  Runs OUTSIDE AD — the engines
+    reduce value_and_grad outputs.  Shared by pipeline/gems/sp_pipeline."""
+    mode = quant.mode("grad") if quant is not None else None
+    if mode:
+        return quantized_pmean(x, axes, mode, quant.block)
+    return lax.pmean(x, axes)
 
 
 @dataclasses.dataclass
@@ -89,6 +103,7 @@ def make_pipeline_train_step(
     bn_stats: bool = True,
     donate: bool = False,
     schedule: str = "gpipe",
+    quant: Optional[QuantPolicy] = None,
 ):
     """Build `(PipelineState, x, labels) -> (PipelineState, metrics)`.
 
@@ -102,6 +117,11 @@ def make_pipeline_train_step(
     accumulation-order rounding; 1F1B always recomputes stage forwards
     inside its backward branches, so ``remat`` is moot there (branches are
     built unwrapped).  docs/pipeline.md covers when to pick which.
+
+    ``quant``: opt-in quantized-collective policy (docs/quantization.md) —
+    ``handoff`` quantizes the tick loop's stage/cotangent ppermutes,
+    ``grad`` the DP gradient/stats pmeans; ``None`` is bit-identical to
+    the unquantized engine.
     """
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"unknown schedule {schedule!r}; use 'gpipe' or '1f1b'")
@@ -121,7 +141,7 @@ def make_pipeline_train_step(
             part, branches,
             vary_axes=(AXIS_STAGE,) + grad_axes,
             from_probs=from_probs, compute_dtype=compute_dtype,
-            seed_scale=loss_scale,
+            seed_scale=loss_scale, quant=quant,
         )
         if schedule == "1f1b"
         else None
@@ -151,6 +171,7 @@ def make_pipeline_train_step(
                         vary_axes=(AXIS_STAGE,) + grad_axes,
                         from_probs=from_probs,
                         compute_dtype=compute_dtype,
+                        quant=quant,
                     )
             # Only the last stage accumulated; psum broadcasts to all stages
             # (and sums over data-parallel groups' mean below).
@@ -170,13 +191,13 @@ def make_pipeline_train_step(
             loss = loss / loss_scale
         if grad_axes:
             with scope("grad_reduce"):
-                grads = lax.pmean(grads, grad_axes)
+                grads = grad_pmean(grads, grad_axes, quant)
         with scope("optimizer_update"):
             new_flat, new_opt = optimizer.update(flat_params, grads, opt_local)
         if with_stats:
             if grad_axes:
                 with scope("stats_reduce"):
-                    stats = lax.pmean(stats, grad_axes)
+                    stats = grad_pmean(stats, grad_axes, quant)
             new_flat = scatter_stage_stats(part, new_flat, stats)
         return (
             new_flat[None],

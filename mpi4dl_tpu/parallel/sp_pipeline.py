@@ -72,11 +72,14 @@ from mpi4dl_tpu.parallel.partition import (
     pad_to,
     stat_leaf_info,
 )
+from mpi4dl_tpu.parallel.pipeline import grad_pmean
 from mpi4dl_tpu.parallel.spatial import (
     apply_junction,
     apply_spatial_region,
     junction_shard_index,
 )
+from mpi4dl_tpu.quant.collectives import quantized_all_gather
+from mpi4dl_tpu.quant.policy import QuantPolicy
 from mpi4dl_tpu.parallel.stage_common import (
     gems_dual_scan,
     gpipe_scan,
@@ -238,6 +241,7 @@ def _make_sp_step(
     bn_stats: bool = True,
     donate: bool = False,
     schedule: str = "gpipe",
+    quant: Optional[QuantPolicy] = None,
 ):
     """Shared scaffolding of the SP(+GEMS) x PP steps: phase-1 spatial region,
     junction, tail scan (``scan_fn``), loss reduction, grad combine, update.
@@ -311,7 +315,7 @@ def _make_sp_step(
             else:
                 sink, c = None, sp_ctx
             act, _ = apply_spatial_region(
-                spp.model, ps, xx, c, levels, remat=remat
+                spp.model, ps, xx, c, levels, remat=remat, quant=quant
             )
             if not with_stats_sp:
                 return act, jnp.zeros((0,), jnp.float32)
@@ -329,11 +333,17 @@ def _make_sp_step(
         # Junction: mosaic-merge tiles; batch-split for LOCAL_DP_LP (via the
         # all_to_all fast path when every tile device takes a distinct shard
         # — degree x less ICI traffic and junction memory than gather+slice).
-        act = apply_junction(act, sp_last, spp.junction, degree)
+        act = apply_junction(act, sp_last, spp.junction, degree, quant=quant)
 
-        # Line all stage chunks up in batch order on every device.
+        # Line all stage chunks up in batch order on every device (junction
+        # wire class: the policy's junction mode quantizes the payload).
+        j_mode = quant.mode("junction") if quant is not None else None
+
         def g(t):  # analysis: ok(unscoped-collective) — applied under scope("stage_lineup") below
-            t = lax.all_gather(t, AXIS_STAGE, axis=0, tiled=True)
+            if j_mode:
+                t = quantized_all_gather(t, AXIS_STAGE, 0, j_mode, quant.block)
+            else:
+                t = lax.all_gather(t, AXIS_STAGE, axis=0, tiled=True)
             return t.reshape(*lead_shape, spp.mb_tail, *t.shape[1:])
 
         with scope("stage_lineup"):
@@ -384,15 +394,19 @@ def _make_sp_step(
         )(sp_buf, tail_flat)
 
         # Identity-on-value invariance bookkeeping (derivation in the module
-        # docstring: AD already psum'd these cotangents home):
+        # docstring: AD already psum'd these cotangents home).  Identity on
+        # the VALUE, not on the wire: these pmeans move the full flat param
+        # buffers per axis, which is why the quant policy's grad class
+        # routes them through the EQuARX-style quantized reduce
+        # (pipeline.grad_pmean).
         with scope("grad_reduce"):
-            g_sp = lax.pmean(g_sp, AXIS_STAGE)
+            g_sp = grad_pmean(g_sp, AXIS_STAGE, quant)
             if tile_axes:
-                g_sp = lax.pmean(g_sp, tile_axes)
-                g_tail = lax.pmean(g_tail, tile_axes)
+                g_sp = grad_pmean(g_sp, tile_axes, quant)
+                g_tail = grad_pmean(g_tail, tile_axes, quant)
             if grad_axes:
-                g_sp = lax.pmean(g_sp, grad_axes)
-                g_tail = lax.pmean(g_tail, grad_axes)
+                g_sp = grad_pmean(g_sp, grad_axes, quant)
+                g_tail = grad_pmean(g_tail, grad_axes, quant)
 
         with scope("optimizer_update"):
             new_sp, new_opt_sp = optimizer.update(sp_buf, g_sp, opt_sp)
@@ -404,7 +418,7 @@ def _make_sp_step(
             # the tile axes are already reduced inside BN (cross-tile psum) or
             # the deposit (per-tile pmean).  sp_buf is fully replicated.
             with scope("stats_reduce"):
-                st = lax.pmean(sp_stats, (AXIS_STAGE,) + grad_axes)
+                st = grad_pmean(sp_stats, (AXIS_STAGE,) + grad_axes, quant)
             new_sp = new_sp.at[jnp.asarray(spp.sp_stat_idx)].set(
                 st.astype(new_sp.dtype)
             )
@@ -415,9 +429,9 @@ def _make_sp_step(
             stt = tail_stats
             with scope("stats_reduce"):
                 if tile_axes:
-                    stt = lax.pmean(stt, tile_axes)
+                    stt = grad_pmean(stt, tile_axes, quant)
                 if grad_axes:
-                    stt = lax.pmean(stt, grad_axes)
+                    stt = grad_pmean(stt, grad_axes, quant)
             new_tail = scatter_stage_stats(part, new_tail, stt)
         return (
             new_sp,
@@ -463,6 +477,7 @@ def make_sp_pipeline_train_step(
     bn_stats: bool = True,
     donate: bool = False,
     schedule: str = "gpipe",
+    quant: Optional[QuantPolicy] = None,
 ):
     """Build `(SPPipelineState, x, labels) -> (SPPipelineState, metrics)`.
 
@@ -475,6 +490,10 @@ def make_sp_pipeline_train_step(
     loop (grad_x=True: the scan's custom_vjp returns the tail-injection
     cotangents so AD can route them back through the junction into the
     spatial region).
+
+    ``quant``: opt-in quantized-collective policy (docs/quantization.md):
+    junction gathers/lineup, respatial reshards, grad/stats reduces, and
+    tail handoffs per the policy's classes; ``None`` is bit-identical.
     """
     part = spp.tail_part
     cache: dict = {}
@@ -488,6 +507,7 @@ def make_sp_pipeline_train_step(
                     from_probs=from_probs,
                     compute_dtype=compute_dtype,
                     grad_x=True,
+                    quant=quant,
                 )
             loss_acc, acc_acc, st_acc = cache["scan"](
                 tail_flat, x_parts, y_parts
@@ -498,12 +518,14 @@ def make_sp_pipeline_train_step(
                 vary_axes=vary_axes,
                 from_probs=from_probs,
                 compute_dtype=compute_dtype,
+                quant=quant,
             )
         return loss_acc, acc_acc, st_acc / parts
 
     return _make_sp_step(
         spp, optimizer, mesh, (parts,), scan_fn, parts,
         compute_dtype, remat, with_data_axis, bn_stats, donate, schedule,
+        quant=quant,
     )
 
 
@@ -520,6 +542,7 @@ def make_sp_gems_train_step(
     bn_stats: bool = True,
     donate: bool = False,
     schedule: str = "gpipe",
+    quant: Optional[QuantPolicy] = None,
 ):
     """SP x GEMS x PP — the reference's flagship 5D composition
     (``train_spatial_master.py``: two spatial models over mirrored rank sets
@@ -549,6 +572,7 @@ def make_sp_gems_train_step(
                     from_probs=from_probs,
                     compute_dtype=compute_dtype,
                     grad_x=True,
+                    quant=quant,
                 )
             loss_acc, acc_acc, stA, stB = cache["scan"](
                 tail_flat, mirror_params, x_parts, y_parts
@@ -559,6 +583,7 @@ def make_sp_gems_train_step(
                 vary_axes=vary_axes,
                 from_probs=from_probs,
                 compute_dtype=compute_dtype,
+                quant=quant,
             )
         with scope("stats_mirror"):
             st = (stA + lax.ppermute(stB, AXIS_STAGE, mirror_perm)) / (2 * times * parts)
@@ -567,4 +592,5 @@ def make_sp_gems_train_step(
     return _make_sp_step(
         spp, optimizer, mesh, (times, 2, parts), scan_fn, 2 * times * parts,
         compute_dtype, remat, with_data_axis, bn_stats, donate, schedule,
+        quant=quant,
     )

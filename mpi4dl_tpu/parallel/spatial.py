@@ -40,6 +40,12 @@ from jax import lax
 from mpi4dl_tpu.cells import CellModel
 from mpi4dl_tpu.layer_ctx import ApplyCtx, SpatialCtx
 from mpi4dl_tpu.obs.scopes import scope
+from mpi4dl_tpu.quant.collectives import (
+    quantized_all_gather,
+    quantized_all_to_all,
+    quantized_ppermute,
+)
+from mpi4dl_tpu.quant.policy import QuantPolicy
 
 Act = Union[jax.Array, Tuple[jax.Array, ...]]
 Levels = Sequence[Tuple[int, SpatialCtx]]
@@ -51,12 +57,25 @@ def _map_act(fn, x: Act) -> Act:
     return fn(x)
 
 
-def _gather_dedup(t: jax.Array, axis_name: str, dim: int, grid: int, rep: int) -> jax.Array:  # analysis: ok(unscoped-collective) — callers own the junction/respatial scopes
+def _mode_block(quant: Optional[QuantPolicy], cls: str):
+    """(mode, block) of a policy class; (None, block) when exact."""
+    if quant is None:
+        return None, 0
+    return quant.mode(cls), quant.block
+
+
+def _gather_dedup(t: jax.Array, axis_name: str, dim: int, grid: int, rep: int,  # analysis: ok(unscoped-collective) — callers own the junction/respatial scopes
+                  mode: Optional[str] = None, block: int = 0) -> jax.Array:
     """all_gather the full extent of `dim` from a (possibly rep-duplicated)
     tile layout: device order along the axis is grid blocks of rep identical
     tiles, so the tiled gather is viewed as (grid, rep, local) and the
-    duplicates dropped."""
-    t = lax.all_gather(t, axis_name, axis=dim, tiled=True)
+    duplicates dropped.  ``mode`` routes the gather through the quantized
+    wire (per-block int8/fp8/int4 payload, quant/collectives.py); the dedup
+    reshape stays outside the quantized op so its AD transpose is shared."""
+    if mode:
+        t = quantized_all_gather(t, axis_name, dim, mode, block)
+    else:
+        t = lax.all_gather(t, axis_name, axis=dim, tiled=True)
     if rep > 1:
         lead = t.shape[:dim]
         local = t.shape[dim] // (grid * rep)
@@ -66,14 +85,19 @@ def _gather_dedup(t: jax.Array, axis_name: str, dim: int, grid: int, rep: int) -
     return t
 
 
-def gather_spatial(x: Act, sp: SpatialCtx, h_dim: int = 1, w_dim: int = 2) -> Act:
-    """Reassemble the full (global-H/W) tensor from tiles on every device."""
+def gather_spatial(x: Act, sp: SpatialCtx, h_dim: int = 1, w_dim: int = 2,
+                   quant: Optional[QuantPolicy] = None) -> Act:
+    """Reassemble the full (global-H/W) tensor from tiles on every device.
+    ``quant``: junction-class payload quantization (docs/quantization.md)."""
+    mode, block = _mode_block(quant, "junction")
 
     def g(t):
         if sp.axis_h and sp.grid_h > 1:
-            t = _gather_dedup(t, sp.axis_h, h_dim, sp.grid_h, sp.rep_h)
+            t = _gather_dedup(t, sp.axis_h, h_dim, sp.grid_h, sp.rep_h,
+                              mode, block)
         if sp.axis_w and sp.grid_w > 1:
-            t = _gather_dedup(t, sp.axis_w, w_dim, sp.grid_w, sp.rep_w)
+            t = _gather_dedup(t, sp.axis_w, w_dim, sp.grid_w, sp.rep_w,
+                              mode, block)
         return t
 
     return _map_act(g, x)
@@ -132,7 +156,8 @@ def can_all_to_all_junction(sp: SpatialCtx, degree: int) -> bool:
 
 
 def batch_split_all_to_all(x: Act, sp: SpatialCtx,  # analysis: ok(unscoped-collective) — apply_junction wraps in scope("junction_batch_split_a2a")
-                           h_dim: int = 1, w_dim: int = 2) -> Act:
+                           h_dim: int = 1, w_dim: int = 2,
+                           quant: Optional[QuantPolicy] = None) -> Act:
     """Tile layout → batch-shard layout in one collective per axis.
 
     Equivalent to ``gather_spatial`` + ``scatter_batch_over_tiles`` with
@@ -141,30 +166,38 @@ def batch_split_all_to_all(x: Act, sp: SpatialCtx,  # analysis: ok(unscoped-coll
     path costs degree× both in ICI traffic and junction memory).  Shard
     order matches :func:`junction_shard_index`: splitting over sph first
     (outer), then spw, puts batch shard ih*grid_w+iw on device (ih, iw).
+    ``quant``: junction-class payload quantization (both transfer
+    directions — a pure permutation, quantized once per crossing).
     """
     assert can_all_to_all_junction(sp, sp.grid_h * sp.grid_w)
+    mode, block = _mode_block(quant, "junction")
+
+    def a2a(t, axis, concat):
+        if mode:
+            return quantized_all_to_all(t, axis, 0, concat, mode, block)
+        return lax.all_to_all(
+            t, axis, split_axis=0, concat_axis=concat, tiled=True
+        )
 
     def s(t):
         if sp.axis_h and sp.grid_h > 1:
-            t = lax.all_to_all(
-                t, sp.axis_h, split_axis=0, concat_axis=h_dim, tiled=True
-            )
+            t = a2a(t, sp.axis_h, h_dim)
         if sp.axis_w and sp.grid_w > 1:
-            t = lax.all_to_all(
-                t, sp.axis_w, split_axis=0, concat_axis=w_dim, tiled=True
-            )
+            t = a2a(t, sp.axis_w, w_dim)
         return t
 
     return _map_act(s, x)
 
 
 def apply_junction(x: Act, sp_last: SpatialCtx, junction: str,
-                   local_dp: Optional[int] = None) -> Act:
+                   local_dp: Optional[int] = None,
+                   quant: Optional[QuantPolicy] = None) -> Act:
     """The SP→LP junction, shared by the pure-SP and SPxPP engines.
 
     'gather': full activation everywhere.  'batch_split': per-device batch
     shard of degree ``local_dp`` (default: final level's tile count), via the
-    all_to_all fast path when every tile device takes a distinct shard."""
+    all_to_all fast path when every tile device takes a distinct shard.
+    ``quant``: opt-in junction-class payload quantization."""
     degree = local_dp if local_dp else sp_last.grid_h * sp_last.grid_w
     if junction == "batch_split":
         n = (x[0] if isinstance(x, tuple) else x).shape[0]
@@ -173,23 +206,94 @@ def apply_junction(x: Act, sp_last: SpatialCtx, junction: str,
         )
         if can_all_to_all_junction(sp_last, degree):
             with scope("junction_batch_split_a2a"):
-                return batch_split_all_to_all(x, sp_last)
+                return batch_split_all_to_all(x, sp_last, quant=quant)
         with scope("junction_batch_split"):
-            x = gather_spatial(x, sp_last)
+            x = gather_spatial(x, sp_last, quant=quant)
             return scatter_batch_over_tiles(x, sp_last, degree=degree)
     with scope("junction_gather"):
-        return gather_spatial(x, sp_last)
+        return gather_spatial(x, sp_last, quant=quant)
+
+
+def respatial_fast_enabled() -> bool:
+    """The gather-free respatial fast paths (refine = local slice,
+    coarsen = intra-group ring exchange) are on by default;
+    ``MPI4DL_NO_RESPATIAL_FAST=1`` keeps the legacy gather+slice path for
+    A/B comparison."""
+    import os
+
+    return os.environ.get("MPI4DL_NO_RESPATIAL_FAST", "0") != "1"
+
+
+def _respatial_refine_slice(t, axis, dim, r_from, r_to, k):
+    """Refinement (finer grid, ``k = g_to // g_from``): every device's new
+    tile is a sub-slice of the source tile it already holds — ZERO
+    collectives (memory-efficient redistribution, arxiv 2112.01075: a
+    reshard whose target blocks nest in the source blocks is local)."""
+    a = lax.axis_index(axis)
+    off = a // r_to - (a // r_from) * k  # target's index inside the source
+    local = t.shape[dim] // k
+    return lax.dynamic_slice_in_dim(t, off * local, local, axis=dim)
+
+
+def _respatial_coarsen_ring(t, axis, dim, k, n, mode, block):
+    """Coarsening from an unreplicated level (``r_from == 1``,
+    ``k = g_from // g_to``): the consumers of target tile ``T`` are exactly
+    the holders of its ``k`` source tiles (the group ``[T*k, (T+1)*k)``),
+    so the reshard is ``k-1`` intra-group cyclic ppermutes, each device
+    accumulating received tiles into its target-tile buffer at the
+    sender's position — wire and peak memory are one TARGET tile
+    (``k/g_from`` of the full extent) instead of the gather path's full
+    extent.  ``mode`` quantizes the ppermute payloads (each source tile
+    encoded once, decoded once; the local copy is placed exact when raw).
+
+    AD of the raw path transposes automatically (slice + reverse permute +
+    sum); the quantized path's custom_vjp inside quantized_ppermute does
+    the same with quantized cotangent slices."""
+    pos0 = lax.axis_index(axis) % k  # my tile's index inside the group
+    L = t.shape[dim]
+    lead, tail = t.shape[:dim], t.shape[dim + 1:]
+    out = jnp.zeros((*lead, k * L, *tail), t.dtype)
+
+    def place(buf, tile, p):
+        return lax.dynamic_update_slice_in_dim(buf, tile, p * L, axis=dim)
+
+    out = place(out, t, pos0)
+    for h in range(1, k):
+        # Group-cyclic shift by h: device b receives the tile of b-h
+        # (same group), whose position is (pos0 - h) mod k.
+        perm = [(b, (b // k) * k + ((b % k) + h) % k) for b in range(n)]
+        if mode:
+            recv = quantized_ppermute(t, axis, perm, mode, block)
+        else:
+            recv = lax.ppermute(t, axis, perm)  # analysis: ok(unscoped-collective) — respatial() wraps the ring in scope("respatial_ring")
+        out = place(out, recv, (pos0 - h) % k)
+    return out
 
 
 def respatial(x: Act, sp_from: SpatialCtx, sp_to: SpatialCtx,
-              h_dim: int = 1, w_dim: int = 2) -> Act:
+              h_dim: int = 1, w_dim: int = 2,
+              quant: Optional[QuantPolicy] = None) -> Act:
     """Re-shard an activation from one spatial level's tile layout to
     another's (the TPU form of the reference's skewed spatial→spatial
-    transition, train_spatial.py:453-504): per dim, gather the full extent
-    (deduplicating any replication) and slice this device's new tile.
+    transition, train_spatial.py:453-504).
+
+    Per dim, in preference order (first two gated by
+    :func:`respatial_fast_enabled`; both avoid ever materializing the full
+    gathered extent on any device — arxiv 2112.01075):
+
+    - refinement (``g_to`` a multiple of ``g_from``): pure local slice;
+    - coarsening from an unreplicated level (``g_from`` a multiple of
+      ``g_to > 1``, ``r_from == 1``): intra-group ring exchange building
+      exactly the target tile (:func:`_respatial_coarsen_ring`);
+    - otherwise: gather the full extent (deduplicating any replication)
+      and slice this device's new tile — the legacy path, and the only
+      one for a fully-degenerate target (``g_to == 1`` IS the full extent).
 
     Both levels must live on the same mesh axes (grid*rep equal per axis).
-    Works for coarsening and refinement; AD gives the reverse re-shard."""
+    AD gives the reverse re-shard in every case.  ``quant``: opt-in
+    respatial-class payload quantization of whichever path runs."""
+    mode, block = _mode_block(quant, "respatial")
+    fast = respatial_fast_enabled()
 
     def dim_pass(t, axis, dim, g_from, r_from, g_to, r_to):
         if axis is None or g_from == g_to:
@@ -198,7 +302,20 @@ def respatial(x: Act, sp_from: SpatialCtx, sp_to: SpatialCtx,
         assert g_from * r_from == g_to * r_to, (
             f"levels disagree on axis size: {g_from}*{r_from} != {g_to}*{r_to}"
         )
-        full = _gather_dedup(t, axis, dim, g_from, r_from) if g_from > 1 else t
+        if fast and g_to > g_from and g_to % g_from == 0:
+            with scope("respatial_refine"):
+                return _respatial_refine_slice(
+                    t, axis, dim, r_from, r_to, g_to // g_from
+                )
+        if (fast and g_to > 1 and r_from == 1 and g_from % g_to == 0):
+            with scope("respatial_ring"):
+                return _respatial_coarsen_ring(
+                    t, axis, dim, g_from // g_to, g_from, mode, block
+                )
+        full = (
+            _gather_dedup(t, axis, dim, g_from, r_from, mode, block)
+            if g_from > 1 else t
+        )
         if g_to == 1:
             return full
         local = full.shape[dim] // g_to
@@ -222,6 +339,7 @@ def apply_spatial_region(
     ctx: ApplyCtx,
     levels: Levels,
     remat=False,
+    quant: Optional[QuantPolicy] = None,
 ) -> Tuple[Act, SpatialCtx]:
     """Run the spatial region: cells [0, stop_i) per level with that level's
     SpatialCtx, respatial transitions between levels.  Returns the activation
@@ -241,7 +359,7 @@ def apply_spatial_region(
         assert stop > start, f"empty spatial level [{start}, {stop})"
         if prev is not None:
             with scope(f"respatial_l{li}"):
-                x = respatial(x, prev, sp_l)
+                x = respatial(x, prev, sp_l, quant=quant)
         if sp_l.active:
             c = ctx.with_spatial(sp_l)
         else:
@@ -271,6 +389,7 @@ def apply_spatial_model(
     levels: Optional[Levels] = None,
     local_dp: Optional[int] = None,
     remat=False,
+    quant: Optional[QuantPolicy] = None,
 ) -> Act:
     """Run the spatial region (one or more levels), junction, then the tail
     replicated (junction='gather') or batch-split (junction='batch_split',
@@ -288,9 +407,9 @@ def apply_spatial_model(
         levels = [(spatial_until, sp)]
 
     x, sp_last = apply_spatial_region(
-        model, params_list, x, ctx, levels, remat=remat
+        model, params_list, x, ctx, levels, remat=remat, quant=quant
     )
-    x = apply_junction(x, sp_last, junction, local_dp)
+    x = apply_junction(x, sp_last, junction, local_dp, quant=quant)
     # BN running-stat deposits in the tail must pmean over the former tile
     # axes: under 'batch_split' the batch genuinely varies per tile device;
     # under 'gather' the all_gathered values are equal but shard_map's

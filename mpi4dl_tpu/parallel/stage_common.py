@@ -26,8 +26,21 @@ from mpi4dl_tpu.cells import checkpointed_apply
 from mpi4dl_tpu.layer_ctx import ApplyCtx
 from mpi4dl_tpu.obs.scopes import scope
 from mpi4dl_tpu.parallel.partition import StagePartition, lax_slice, pad_to
+from mpi4dl_tpu.quant.collectives import quantized_ppermute
+from mpi4dl_tpu.quant.policy import QuantPolicy
 from mpi4dl_tpu.train import accuracy, cross_entropy
 from mpi4dl_tpu.mesh import AXIS_STAGE
+
+
+def _handoff(y, perm, quant: Optional[QuantPolicy]):  # analysis: ok(unscoped-collective) — every caller wraps in scope("stage_handoff"/"cot_handoff")
+    """One stage-handoff/cotangent ppermute, quantized when the policy's
+    ``handoff`` class is on (per-block payload over the flat [act_max]
+    buffer; quant/collectives.py).  The GEMS mirror ppermute must NOT go
+    through here — it moves parameters, which are never quantized."""
+    mode = quant.mode("handoff") if quant is not None else None
+    if mode:
+        return quantized_ppermute(y, AXIS_STAGE, perm, mode, quant.block)
+    return lax.ppermute(y, AXIS_STAGE, perm)
 
 
 def make_stage_branches(
@@ -131,6 +144,7 @@ def gpipe_scan(
     vary_axes: Tuple[str, ...],
     from_probs: bool,
     compute_dtype,
+    quant: Optional[QuantPolicy] = None,
 ):
     """The GPipe tick loop (reference run_step, mp_pipeline.py:509-534).
 
@@ -184,9 +198,7 @@ def gpipe_scan(
         # Hand activations to the next stage (non-wrap: stage 0's stale recv
         # is overwritten by injection next tick).
         with scope("stage_handoff"):
-            buf = lax.ppermute(
-                y, AXIS_STAGE, [(i, i + 1) for i in range(S - 1)]
-            )
+            buf = _handoff(y, [(i, i + 1) for i in range(S - 1)], quant)
         return (buf, loss_acc, acc_acc, st_acc), None
 
     # Initial carries must be marked varying over the axes the loop makes
@@ -498,6 +510,7 @@ def make_1f1b_scan(
     compute_dtype,
     seed_scale: float = 1.0,
     grad_x: bool = False,
+    quant: Optional[QuantPolicy] = None,
 ):
     """Build the 1F1B tick loop as a ``jax.custom_vjp`` drop-in for
     :func:`gpipe_scan`: ``f(flat_params, x_parts, y_parts) -> (loss_acc,
@@ -615,7 +628,7 @@ def make_1f1b_scan(
             acc_acc = acc_acc + jnp.where(out_here, a, 0.0)
             with scope("fwd_tick"), scope("stage_handoff"):
                 nbuf = (
-                    lax.ppermute(y, AXIS_STAGE, fwd_perm)
+                    _handoff(y, fwd_perm, quant)
                     if fwd_perm
                     else jnp.zeros_like(y)
                 )
@@ -636,7 +649,7 @@ def make_1f1b_scan(
                     )
                 with scope("cot_handoff"):
                     cot = (
-                        lax.ppermute(ga, AXIS_STAGE, rev_perm)
+                        _handoff(ga, rev_perm, quant)
                         if rev_perm
                         else jnp.zeros_like(ga)
                     )
@@ -685,6 +698,7 @@ def gems_dual_scan(
     vary_axes: Tuple[str, ...],
     from_probs: bool,
     compute_dtype,
+    quant: Optional[QuantPolicy] = None,
 ):
     """The GEMS bidirectional tick loop (reference gems_master.py:72-103).
 
@@ -763,8 +777,8 @@ def gems_dual_scan(
                 + jnp.where(validB, accuracy(logitsB, lblB), 0.0)
             )
             with scope("stage_handoff"):
-                bufA = lax.ppermute(yA, AXIS_STAGE, fwd_perm)
-                bufB = lax.ppermute(yB, AXIS_STAGE, bwd_perm)
+                bufA = _handoff(yA, fwd_perm, quant)
+                bufB = _handoff(yB, bwd_perm, quant)
             return (bufA, bufB, l_acc, a_acc, stA, stB), None
 
         init = (
@@ -796,6 +810,7 @@ def make_gems_1f1b_scan(
     compute_dtype,
     seed_scale: float = 1.0,
     grad_x: bool = False,
+    quant: Optional[QuantPolicy] = None,
 ):
     """1F1B counterpart of :func:`gems_dual_scan` (see :func:`make_1f1b_scan`
     for the schedule/custom_vjp design): ``f(flat_params, mirror_params,
@@ -914,11 +929,11 @@ def make_gems_1f1b_scan(
                 )
                 with scope("fwd_tick"), scope("stage_handoff"):
                     nbufA = (
-                        lax.ppermute(yA, AXIS_STAGE, fwd_perm)
+                        _handoff(yA, fwd_perm, quant)
                         if fwd_perm else jnp.zeros_like(yA)
                     )
                     nbufB = (
-                        lax.ppermute(yB, AXIS_STAGE, rev_perm)
+                        _handoff(yB, rev_perm, quant)
                         if rev_perm else jnp.zeros_like(yB)
                     )
                 with scope("bwd_tick"):
@@ -943,11 +958,11 @@ def make_gems_1f1b_scan(
                         )
                     with scope("cot_handoff"):
                         cotA = (
-                            lax.ppermute(gaA, AXIS_STAGE, rev_perm)
+                            _handoff(gaA, rev_perm, quant)
                             if rev_perm else jnp.zeros_like(gaA)
                         )
                         cotB = (
-                            lax.ppermute(gaB, AXIS_STAGE, fwd_perm)
+                            _handoff(gaB, fwd_perm, quant)
                             if fwd_perm else jnp.zeros_like(gaB)
                         )
                 return (nbufA, nbufB, cotA, cotB, resA, resB,

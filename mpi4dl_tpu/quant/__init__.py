@@ -1,0 +1,64 @@
+"""Quantized collectives (ISSUE 10 tentpole; EQuARX, arxiv 2506.17615).
+
+The overlap observatory measured the 8K flagship moving 31.0 GB/step of
+100% structurally-exposed wire, with the SP→LP junction gathers (20.4 GB)
+and the pipeline handoffs (8.9 GB) owning ~95% of the bytes (PERF_NOTES
+"overlap observatory").  Before any overlap kernel can hide that wire, the
+cheapest win is to shrink it: this package quantizes the *payload that
+crosses the wire* — per-block-scaled bf16/f32 → int8/fp8/packed-int4
+encode, collective on the packed payload (+ a small f32 scale tensor),
+decode on arrival — at the hot collective classes:
+
+- ``junction``   — SP→LP junction gathers / batch-split all_to_all and the
+  stage-lineup all_gather (``parallel/spatial.py``, ``sp_pipeline.py``);
+- ``respatial``  — level-transition reshards (which also grow gather-free
+  fast paths so transitions never materialize the full activation —
+  memory-efficient redistribution, arxiv 2112.01075);
+- ``grad``       — the DP/stage gradient + BN-stats ``pmean``s, done
+  EQuARX-style as quantized all_to_all → exact f32 dequant-accumulate per
+  shard → quantized all_gather (one quantization per value, no per-hop
+  re-quantization);
+- ``handoff``    — the pipeline stage/cotangent handoff ppermutes
+  (``stage_common.py`` tick loops).
+
+Everything is **opt-in** (``--quant`` / ``ParallelConfig.quant_collectives``
+/ the ``MPI4DL_QUANT_COLLECTIVES`` hatch; default off is bit-identical to
+the unquantized engines) with a per-collective-class policy
+(:class:`QuantPolicy`).  Exactness policy per class: junction/respatial/
+handoff activations tolerate quantization (error-bound property tests,
+tests/test_quant.py); the gradient class rides an A/B convergence gate
+through the supervised loop (CI ``quant-contract`` job).  Forward payloads
+are quantized; the junction/respatial gather *transpose* (reduce-scatter of
+cotangents) stays exact.  See docs/quantization.md.
+"""
+
+from __future__ import annotations
+
+from mpi4dl_tpu.quant.policy import HOT_SCOPE_PATTERNS, QuantPolicy
+from mpi4dl_tpu.quant.kernels import (
+    MODES,
+    dequantize,
+    quant_error_bound,
+    quantize,
+)
+from mpi4dl_tpu.quant.collectives import (
+    quantized_all_gather,
+    quantized_all_to_all,
+    quantized_pmean,
+    quantized_pmean_tree,
+    quantized_ppermute,
+)
+
+__all__ = [
+    "HOT_SCOPE_PATTERNS",
+    "MODES",
+    "QuantPolicy",
+    "dequantize",
+    "quant_error_bound",
+    "quantize",
+    "quantized_all_gather",
+    "quantized_all_to_all",
+    "quantized_pmean",
+    "quantized_pmean_tree",
+    "quantized_ppermute",
+]

@@ -1,0 +1,230 @@
+"""Quantized collective wrappers (the wire layer of the quant package).
+
+Each wrapper keeps the calling engine's semantics — same result shape,
+same vma/replication behaviour as the raw collective it replaces — while
+moving a per-block-quantized payload plus a small f32 scale tensor on the
+wire instead of the full-precision tensor (quant/kernels.py).  Values are
+quantized exactly once per wire crossing and accumulated in f32 after
+dequantization — no per-hop re-quantization anywhere, so the error of any
+output element is one quantization step of its block (summed over the
+contributions it aggregates, for the reductions).
+
+AD: forward wrappers used inside differentiated code carry a
+``jax.custom_vjp`` (quantization is round-to-nearest — without one, AD
+would produce zero/undefined cotangents through the int casts):
+
+- :func:`quantized_all_gather`  — fwd: quantized tiled all_gather;
+  bwd: the raw gather's EXACT transpose (``psum_scatter`` of the
+  cotangent, unquantized) — the activations tolerate quantization, the
+  junction's reduce-scattered cotangent accumulation stays exact;
+- :func:`quantized_all_to_all`  — pure permutation both ways, so both
+  directions quantize (one encode each, nothing accumulates);
+- :func:`quantized_ppermute`    — same, for the pipeline handoffs (the
+  cotangent handoff is itself a ppermute — the reverse-perm payload is
+  quantized, A/B-convergence-gated).
+
+:func:`quantized_pmean` is the EQuARX-style two-shot all-reduce
+(quantized all_to_all → exact f32 dequant-accumulate per shard → mean →
+quantized all_gather).  It is used OUTSIDE AD (the engines' grad/stats
+reduces run on value_and_grad outputs), so it carries no vjp rule.  The
+trailing all_gather also re-establishes axis-invariance of the result
+under vma-aware jax, exactly like the raw ``pmean`` it replaces.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mpi4dl_tpu.quant.kernels import dequantize, quantize
+
+# Collectives here deliberately have no obs.scope of their own: every call
+# site in parallel/ wraps them in the owning scope (junction_gather,
+# stage_handoff, grad_reduce, ...) so the contract gate and the overlap
+# ledger attribute the quantized payload to the same scope vocabulary as
+# the raw collective it replaced.
+
+
+def _axis_size(axis_name: str) -> int:
+    """Static size of a named mesh axis inside shard_map (psum of a
+    concrete 1 constant-folds to the axis size)."""
+    return int(lax.psum(1, axis_name))
+
+
+def quantized_all_gather(t: jax.Array, axis_name: str, dim: int,
+                         mode: str, block: int) -> jax.Array:
+    """Tiled ``all_gather`` over ``axis_name`` into ``dim`` with a
+    quantized wire payload; backward is the raw gather's exact transpose
+    (``psum_scatter`` of the cotangent)."""
+    ndim = t.ndim
+    if dim < 0:
+        dim += ndim
+    if ndim < 2 or dim == ndim - 1:
+        # Block axis (last) must survive the gather; rank-1/last-dim
+        # gathers fall back to the exact collective.
+        return lax.all_gather(t, axis_name, axis=dim, tiled=True)
+    c, dtype = t.shape[-1], t.dtype
+
+    def _fwd_impl(x):
+        q, s = quantize(x, mode, block)
+        qg = lax.all_gather(q, axis_name, axis=dim, tiled=True)
+        sg = lax.all_gather(s, axis_name, axis=dim, tiled=True)
+        return dequantize(qg, sg, mode, block, c, dtype)
+
+    @jax.custom_vjp
+    def qag(x):
+        return _fwd_impl(x)
+
+    def fwd(x):
+        return _fwd_impl(x), None
+
+    def bwd(_, ct):
+        return (lax.psum_scatter(
+            ct, axis_name, scatter_dimension=dim, tiled=True
+        ).astype(dtype),)
+
+    qag.defvjp(fwd, bwd)
+    return qag(t)
+
+
+def quantized_all_to_all(t: jax.Array, axis_name: str, split_axis: int,
+                         concat_axis: int, mode: str, block: int
+                         ) -> jax.Array:
+    """Tiled ``all_to_all`` with quantized payload; the transpose is the
+    reverse all_to_all, also quantized (pure permutation: one encode per
+    direction, nothing accumulates)."""
+    ndim = t.ndim
+    if split_axis < 0:
+        split_axis += ndim
+    if concat_axis < 0:
+        concat_axis += ndim
+    if ndim < 2 or split_axis >= ndim - 1 or concat_axis >= ndim - 1:
+        return lax.all_to_all(t, axis_name, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)
+    dtype = t.dtype
+
+    def _a2a(x, sa, ca):
+        c = x.shape[-1]
+        q, s = quantize(x, mode, block)
+        qx = lax.all_to_all(q, axis_name, split_axis=sa, concat_axis=ca,
+                            tiled=True)
+        sx = lax.all_to_all(s, axis_name, split_axis=sa, concat_axis=ca,
+                            tiled=True)
+        return dequantize(qx, sx, mode, block, c, dtype)
+
+    @jax.custom_vjp
+    def qa2a(x):
+        return _a2a(x, split_axis, concat_axis)
+
+    def fwd(x):
+        return _a2a(x, split_axis, concat_axis), None
+
+    def bwd(_, ct):
+        return (_a2a(ct.astype(dtype), concat_axis, split_axis),)
+
+    qa2a.defvjp(fwd, bwd)
+    return qa2a(t)
+
+
+def quantized_ppermute(t: jax.Array, axis_name: str,
+                       perm: Sequence[Tuple[int, int]], mode: str,
+                       block: int) -> jax.Array:
+    """``ppermute`` with quantized payload; the transpose permutes the
+    (quantized) cotangent along the reversed pairs — exactly the raw
+    ppermute's transpose with a quantized wire.  Devices outside the perm
+    receive zeros, like the raw collective (zero payload × zero scales)."""
+    dtype = t.dtype
+    c = t.shape[-1]
+    perm = tuple(perm)
+    rev = tuple((d, s) for s, d in perm)
+
+    def _perm(x, p):
+        q, s = quantize(x, mode, block)
+        qp = lax.ppermute(q, axis_name, p)
+        sp = lax.ppermute(s, axis_name, p)
+        return dequantize(qp, sp, mode, block, c, dtype)
+
+    @jax.custom_vjp
+    def qpp(x):
+        return _perm(x, perm)
+
+    def fwd(x):
+        return _perm(x, perm), None
+
+    def bwd(_, ct):
+        return (_perm(ct.astype(dtype), rev),)
+
+    qpp.defvjp(fwd, bwd)
+    return qpp(t)
+
+
+def quantized_pmean(x: jax.Array, axes, mode: str, block: int) -> jax.Array:
+    """EQuARX-style quantized ``pmean`` over one or more named axes, one
+    axis at a time (mean of means — group sizes are uniform on a mesh):
+
+    flatten → pad → quantize once → all_to_all the payload chunks →
+    dequantize and accumulate the mean EXACTLY in f32 per shard →
+    re-quantize the shard → all_gather → dequantize.
+
+    Two 1-byte payload collectives (+ two small f32 scale collectives)
+    instead of one 4-byte all-reduce; each input value is quantized once
+    on the way in and the reduced shard once on the way out.  Call it
+    OUTSIDE differentiated code (grad/stats reduces) — it has no vjp rule.
+    """
+    if isinstance(axes, str):
+        axes = (axes,)
+    orig_shape, orig_dtype = x.shape, x.dtype
+    v = x.astype(jnp.float32).ravel()
+    for ax in axes:
+        v = _qpmean_axis(v, ax, mode, block)
+    return v.reshape(orig_shape).astype(orig_dtype)
+
+
+def _qpmean_axis(v: jax.Array, axis_name: str, mode: str,
+                 block: int) -> jax.Array:
+    n = _axis_size(axis_name)
+    if n <= 1:
+        return v
+    size = v.shape[0]
+    group = n * block
+    padded = group * (-(-size // group))
+    if padded != size:
+        v = jnp.pad(v, (0, padded - size))
+    q, s = quantize(v, mode, block)  # 1-D: blocks along the vector
+    # Chunk i of the payload (and its chunk-aligned scales) goes to device
+    # i; every chunk boundary is a block boundary by construction.
+    qx = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
+                        tiled=True)
+    sx = lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0,
+                        tiled=True)
+    shard_dim = padded // n
+    rows = dequantize(qx.reshape(n, -1), sx.reshape(n, -1), mode, block,
+                      shard_dim, jnp.float32)
+    shard = rows.sum(axis=0) / n  # exact f32 dequant-accumulate per shard
+    q2, s2 = quantize(shard, mode, block)
+    qg = lax.all_gather(q2, axis_name, axis=0, tiled=True)
+    sg = lax.all_gather(s2, axis_name, axis=0, tiled=True)
+    out = dequantize(qg, sg, mode, block, padded, jnp.float32)
+    return out[:size] if padded != size else out
+
+
+def quantized_pmean_tree(tree, axes, mode: str, block: int):
+    """:func:`quantized_pmean` over a whole pytree as ONE flattened vector
+    (one collective pair per axis instead of one per leaf — the gradient
+    pytree of the single-shard_map spatial engine has hundreds of leaves)."""
+    leaves, treedef = jax.tree.flatten(tree)
+    if not leaves:
+        return tree
+    sizes = [l.size for l in leaves]
+    flat = jnp.concatenate(
+        [l.astype(jnp.float32).ravel() for l in leaves]
+    )
+    flat = quantized_pmean(flat, axes, mode, block)
+    out, off = [], 0
+    for l, sz in zip(leaves, sizes):
+        out.append(flat[off:off + sz].reshape(l.shape).astype(l.dtype))
+        off += sz
+    return jax.tree.unflatten(treedef, out)

@@ -1,0 +1,120 @@
+"""Per-block quantize/dequantize kernels (EQuARX-style payload encoding).
+
+The unit every quantized collective moves is ``(payload, scales)``:
+
+- blocks of ``block`` consecutive elements along the LAST axis share one
+  f32 scale (``absmax / qmax``), so any collective that gathers/splits/
+  permutes over a *non-last* axis applies identically to payload and
+  scales — the block structure rides along for free;
+- ``int8``: symmetric round-to-nearest into [-127, 127] (1 byte/elt);
+- ``fp8``:  ``float8_e4m3fn`` payload after the same per-block pre-scale
+  (1 byte/elt, more mantissa near the block max, softer clipping);
+- ``int4``: symmetric into [-7, 7], PACKED two nibbles per int8 byte
+  (0.5 bytes/elt) — packing along the last axis keeps the wire payload a
+  plain s8 tensor, so no sub-byte dtype ever reaches a collective.
+
+Error model (property-tested in tests/test_quant.py): round-to-nearest on
+a symmetric grid gives ``|x - deq(q(x))| <= scale / 2`` per element, i.e.
+``absmax_block / (2 * qmax)`` — elements are off by at most half a
+quantization step of their own block, whatever the block's dynamic range.
+fp8's grid is relative (3 mantissa bits): half-ulp ``|x| * 2**-4`` per
+element, at most ``absmax_block * 2**-4``.
+Zero blocks round-trip exactly (scale falls back to 1); odd tails (last
+dim not a multiple of ``block``) are handled by absmax over the partial
+block — no payload padding crosses the wire (int4 pads at most one
+nibble).  A NaN/Inf element poisons its whole BLOCK to NaN (the block
+scale goes non-finite and dequant multiplies by it) — coarser than a raw
+collective's element-wise propagation, but non-finites never silently
+decode to zeros, so the resilience anomaly guard still sees them.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+MODES: Tuple[str, ...] = ("int8", "fp8", "int4")
+
+_QMAX = {"int8": 127.0, "fp8": 448.0, "int4": 7.0}
+
+
+def quant_error_bound(mode: str):
+    """Per-element worst-case absolute error as a fraction of the owning
+    block's absmax (the property tests' bound).  int grids: half a
+    quantization step (``absmax / (2*qmax)``).  fp8 (e4m3, 3 mantissa
+    bits): relative half-ulp ``2**-4`` of the element, bounded here by the
+    block absmax."""
+    if mode == "fp8":
+        return 2.0 ** -4
+    return 0.5 / _QMAX[mode]
+
+
+def _nblocks(n: int, block: int) -> int:
+    return -(-n // block)
+
+
+def block_scales(x: jax.Array, mode: str, block: int) -> jax.Array:
+    """f32 per-block scales, shape ``x.shape[:-1] + (ceil(C/block),)``."""
+    lead, c = x.shape[:-1], x.shape[-1]
+    nb = _nblocks(c, block)
+    ax = jnp.abs(x.astype(jnp.float32))
+    pad = nb * block - c
+    if pad:
+        ax = jnp.pad(ax, [(0, 0)] * len(lead) + [(0, pad)])
+    amax = ax.reshape(*lead, nb, block).max(axis=-1)
+    # `amax == 0` (not `> 0`) so a NaN/Inf block absmax keeps its NaN/Inf
+    # scale: the int payload drops non-finites to 0 on cast, but dequant
+    # multiplies by the non-finite scale, so the block decodes to NaN —
+    # non-finite inputs POISON their block instead of silently becoming
+    # zeros (the anomaly guard then sees them, like raw collectives).
+    return jnp.where(amax == 0, 1.0, amax / _QMAX[mode])
+
+
+def _expand_scales(scales: jax.Array, block: int, c: int) -> jax.Array:
+    return jnp.repeat(scales, block, axis=-1)[..., :c]
+
+
+def payload_dim(c: int, mode: str) -> int:
+    """Last-axis extent of the wire payload for a tensor with last dim
+    ``c`` (int4 packs two elements per byte, padding one nibble if odd)."""
+    return (c + 1) // 2 if mode == "int4" else c
+
+
+def quantize(x: jax.Array, mode: str, block: int
+             ) -> Tuple[jax.Array, jax.Array]:
+    """``x -> (payload, scales)``.  Payload dtype: s8 (int8/int4-packed)
+    or float8_e4m3fn (fp8); scales f32."""
+    assert mode in MODES, mode
+    c = x.shape[-1]
+    scales = block_scales(x, mode, block)
+    se = _expand_scales(scales, block, c)
+    xf = x.astype(jnp.float32) / se
+    if mode == "fp8":
+        return xf.astype(jnp.float8_e4m3fn), scales
+    qmax = _QMAX[mode]
+    q = jnp.clip(jnp.round(xf), -qmax, qmax).astype(jnp.int8)
+    if mode == "int4":
+        if c % 2:
+            q = jnp.pad(q, [(0, 0)] * (x.ndim - 1) + [(0, 1)])
+        lo, hi = q[..., 0::2], q[..., 1::2]
+        q = ((lo & 0x0F) | (hi << 4)).astype(jnp.int8)
+    return q, scales
+
+
+def dequantize(payload: jax.Array, scales: jax.Array, mode: str, block: int,
+               out_dim: int, dtype) -> jax.Array:
+    """Inverse of :func:`quantize`; ``out_dim`` is the original last-axis
+    extent (needed to strip int4's pad nibble and the scale tail)."""
+    assert mode in MODES, mode
+    if mode == "int4":
+        lo = (payload << 4) >> 4  # arithmetic shifts sign-extend nibbles
+        hi = payload >> 4
+        q = jnp.stack([lo, hi], axis=-1).reshape(
+            *payload.shape[:-1], 2 * payload.shape[-1]
+        )[..., :out_dim]
+    else:
+        q = payload
+    se = _expand_scales(scales, block, out_dim)
+    return (q.astype(jnp.float32) * se).astype(dtype)

@@ -1,0 +1,138 @@
+"""Per-collective-class quantization policy.
+
+A policy maps each of the four hot collective classes (``junction``,
+``respatial``, ``grad``, ``handoff`` — the vocabulary of the overlap
+ledger's wire classes, obs/overlap.py) to a payload mode (``int8`` /
+``fp8`` / ``int4`` / ``off``) plus the shared block size for the per-block
+scales.  The spec grammar (config ``--quant``, hatch
+``MPI4DL_QUANT_COLLECTIVES``)::
+
+    off                          # everything exact (the default)
+    int8                         # every class int8 (also fp8 / int4)
+    junction=int4,grad=int8      # per-class; unnamed classes stay off
+    int8,block=128               # mode plus block-size override
+
+This module is deliberately jax-free: the static analyzer (rule 11,
+``unquantized-collective``) imports :data:`HOT_SCOPE_PATTERNS` to know
+which ``obs.scope`` names are on the hot list without paying a jax import.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+from typing import Optional, Tuple
+
+CLASSES: Tuple[str, ...] = ("junction", "respatial", "grad", "handoff")
+_MODES = ("off", "int8", "fp8", "int4")
+
+DEFAULT_BLOCK = 256
+
+# obs.scope name patterns of the collectives each class owns — shared by
+# analyzer rule 11 (the hot list), the contract ratio gate
+# (analysis/contracts/diff.quant_byte_ratios), and docs/quantization.md.
+# loss_reduce and the in-cell BN psums are deliberately NOT hot: scalar
+# payloads, kept exact.
+HOT_SCOPE_PATTERNS = {
+    "junction": re.compile(r"junction|stage_lineup"),
+    "respatial": re.compile(r"respatial"),
+    "grad": re.compile(r"grad_reduce|stats_reduce"),
+    "handoff": re.compile(r"stage_handoff|cot_handoff"),
+}
+
+
+def scope_quant_class(scope: str) -> Optional[str]:
+    """The quantization class owning an ``obs.scope`` path, or None."""
+    for cls, pat in HOT_SCOPE_PATTERNS.items():
+        if pat.search(scope or ""):
+            return cls
+    return None
+
+
+@dataclasses.dataclass(frozen=True)
+class QuantPolicy:
+    """One resolved per-class payload policy.  ``off`` per class = that
+    class's collectives stay exact; an all-off policy is represented as
+    ``None`` at the call sites (bit-identical engines)."""
+
+    junction: str = "off"
+    respatial: str = "off"
+    grad: str = "off"
+    handoff: str = "off"
+    block: int = DEFAULT_BLOCK
+
+    def mode(self, cls: str) -> Optional[str]:
+        """Payload mode for a class, or None when the class is exact."""
+        m = getattr(self, cls)
+        return None if m == "off" else m
+
+    @property
+    def active(self) -> bool:
+        return any(self.mode(c) for c in CLASSES)
+
+    def spec(self) -> str:
+        """Canonical spec string (round-trips through :meth:`parse`)."""
+        if not self.active:
+            return "off"
+        parts = [f"{c}={getattr(self, c)}" for c in CLASSES
+                 if self.mode(c)]
+        if self.block != DEFAULT_BLOCK:
+            parts.append(f"block={self.block}")
+        return ",".join(parts)
+
+    @classmethod
+    def parse(cls, spec: Optional[str]) -> Optional["QuantPolicy"]:
+        """Parse a spec string; returns None for off/empty (quant disabled).
+        Raises ValueError on an unknown class or mode."""
+        spec = (spec or "").strip()
+        if spec in ("", "off", "0", "none"):
+            return None
+        # Two passes so the grammar is ORDER-INDEPENDENT: bare mode tokens
+        # set the default for every class, then class=mode pairs override —
+        # "junction=off,int8" and "int8,junction=off" both keep the
+        # junction exact (a bare token clobbering earlier pairs would
+        # silently invert an exactness policy).
+        items = [s.strip() for s in spec.split(",") if s.strip()]
+        fields = {c: "off" for c in CLASSES}
+        block = DEFAULT_BLOCK
+        for item in items:
+            if "=" in item:
+                continue
+            if item not in _MODES:
+                raise ValueError(
+                    f"unknown quant mode {item!r}; have {_MODES} "
+                    "(or class=mode pairs)"
+                )
+            fields = {c: item for c in CLASSES}
+        for item in items:
+            if "=" not in item:
+                continue
+            key, _, val = item.partition("=")
+            key, val = key.strip(), val.strip()
+            if key == "block":
+                block = int(val)
+                if block <= 0 or block % 2:
+                    raise ValueError(
+                        f"quant block must be positive and even "
+                        f"(int4 packs payload pairs): {block}"
+                    )
+                continue
+            if key not in CLASSES:
+                raise ValueError(
+                    f"unknown quant class {key!r}; have {CLASSES}"
+                )
+            if val not in _MODES:
+                raise ValueError(
+                    f"unknown quant mode {val!r}; have {_MODES}"
+                )
+            fields[key] = val
+        p = cls(block=block, **fields)
+        return p if p.active else None
+
+    @classmethod
+    def resolve(cls, config_spec: Optional[str]) -> Optional["QuantPolicy"]:
+        """Config spec with the ``MPI4DL_QUANT_COLLECTIVES`` hatch override
+        (set = wins, including ``off`` to force-disable)."""
+        hatch = os.environ.get("MPI4DL_QUANT_COLLECTIVES")
+        return cls.parse(hatch if hatch is not None else config_spec)

@@ -348,6 +348,7 @@ def make_spatial_train_step(
     local_dp: Optional[int] = None,
     donate: bool = False,
     remat=False,
+    quant=None,
 ):
     """SP(+DP) training step: one shard_map over the whole step.
     ``remat`` threads per-cell checkpointing through the spatial region and
@@ -362,6 +363,11 @@ def make_spatial_train_step(
     ``levels`` is a list of (stop_cell, SpatialCtx) for multi-level spatial
     parallelism (reference num_spatial_parts="4,2"); ``sp`` must be the
     level-0 ctx (it defines the mesh axes and the input sharding).
+
+    ``quant`` (Optional[QuantPolicy], docs/quantization.md): junction/
+    respatial payload quantization inside ``apply_spatial_model`` and the
+    EQuARX-style quantized gradient pmean (the whole gradient pytree
+    reduced as ONE flattened vector); ``None`` is bit-identical.
     """
     from mpi4dl_tpu.parallel.spatial import (
         apply_spatial_model,
@@ -377,6 +383,7 @@ def make_spatial_train_step(
         logits = apply_spatial_model(
             model, params_list, x, c, spatial_until=spatial_until,
             junction=junction, levels=levels, local_dp=local_dp, remat=remat,
+            quant=quant,
         )
         if isinstance(logits, tuple):
             logits = logits[0]
@@ -442,7 +449,15 @@ def make_spatial_train_step(
             stats = jax.tree.map(lambda s: s / parts, stats)
             loss, acc = loss / parts, acc / parts
 
-        grads = jax.tree.map(lambda g: lax.pmean(g, grad_axes), grads)
+        grad_mode = quant.mode("grad") if quant is not None else None
+        if grad_mode:
+            from mpi4dl_tpu.quant.collectives import quantized_pmean_tree
+
+            grads = quantized_pmean_tree(
+                grads, grad_axes, grad_mode, quant.block
+            )
+        else:
+            grads = jax.tree.map(lambda g: lax.pmean(g, grad_axes), grads)
         new_params, new_opt = optimizer.update(params, grads, opt_state)
         new_params = merge_stat_updates(new_params, stats)
         metrics = {

@@ -35,6 +35,21 @@ jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+from mpi4dl_tpu.compat import LEGACY_JAX  # noqa: E402
+
+# Version-guarded skip for the documented old-jax failure set, shared by
+# the engine/remat exactness test files (`from conftest import
+# skip_old_jax`): legacy jax (no top-level jax.shard_map — the 0.4.x line
+# the contract goldens pin) runs shard_map with check_rep=False AD and
+# no-op vma varying-marks, so exactness is not guaranteed there
+# (mpi4dl_tpu/compat.py).  Auto-unskips on any vma-aware jax.
+skip_old_jax = pytest.mark.skipif(
+    LEGACY_JAX,
+    reason="known old-jax failure: legacy shard_map (check_rep=False AD, "
+           "no vma) breaks exactness; needs vma-aware jax "
+           "(mpi4dl_tpu/compat.py)",
+)
+
 
 @pytest.fixture(scope="session")
 def devices8():

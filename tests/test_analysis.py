@@ -1111,3 +1111,142 @@ def test_unscoped_collective_local_helper_not_flagged(tmp_path):
         filename="mpi4dl_tpu/parallel/fix.py",
     )
     assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# (11) unquantized-collective
+# ---------------------------------------------------------------------------
+
+
+def test_unquantized_collective_positive(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        from jax import lax
+        from mpi4dl_tpu.obs.scopes import scope
+
+        def junction(x):
+            with scope("junction_gather"):
+                return lax.all_gather(x, "spw", axis=1, tiled=True)
+        """,
+        rule="unquantized-collective",
+        filename="mpi4dl_tpu/parallel/fix.py",
+    )
+    assert len(vs) == 1 and "junction_gather" in vs[0].message
+
+
+def test_unquantized_collective_quant_aware_negative(tmp_path):
+    """The raw collective is fine as the policy-off branch of a
+    quant-aware function (a `quant` parameter / quantized_* call)."""
+    vs = _run(
+        tmp_path,
+        """
+        from jax import lax
+        from mpi4dl_tpu.obs.scopes import scope
+        from mpi4dl_tpu.quant.collectives import quantized_all_gather
+
+        def junction(x, quant=None):
+            with scope("junction_gather"):
+                if quant is not None:
+                    return quantized_all_gather(x, "spw", 1, "int8", 256)
+                return lax.all_gather(x, "spw", axis=1, tiled=True)
+        """,
+        rule="unquantized-collective",
+        filename="mpi4dl_tpu/parallel/fix.py",
+    )
+    assert vs == []
+
+
+def test_unquantized_collective_cold_scope_negative(tmp_path):
+    """loss_reduce is not on the hot list (scalar payloads stay exact)."""
+    vs = _run(
+        tmp_path,
+        """
+        from jax import lax
+        from mpi4dl_tpu.obs.scopes import scope
+
+        def reduce_loss(x):
+            with scope("loss_reduce"):
+                return lax.psum(x, "stage")
+        """,
+        rule="unquantized-collective",
+        filename="mpi4dl_tpu/parallel/fix.py",
+    )
+    assert vs == []
+
+
+def test_unquantized_collective_outside_parallel_negative(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        from jax import lax
+        from mpi4dl_tpu.obs.scopes import scope
+
+        def junction(x):
+            with scope("junction_gather"):
+                return lax.all_gather(x, "spw", axis=1, tiled=True)
+        """,
+        rule="unquantized-collective",
+        filename="mpi4dl_tpu/ops/fix.py",
+    )
+    assert vs == []
+
+
+def test_unquantized_collective_fstring_scope_positive(tmp_path):
+    """Hot-class tokens in f-string scope names (respatial_l{i}) match."""
+    vs = _run(
+        tmp_path,
+        """
+        from jax import lax
+        from mpi4dl_tpu.obs.scopes import scope
+
+        def reshard(x, li):
+            with scope(f"respatial_l{li}"):
+                return lax.all_gather(x, "spw", axis=1, tiled=True)
+        """,
+        rule="unquantized-collective",
+        filename="mpi4dl_tpu/parallel/fix.py",
+    )
+    assert len(vs) == 1
+
+
+def test_unquantized_collective_pragma_suppresses(tmp_path):
+    vs = _run(
+        tmp_path,
+        """
+        from jax import lax
+        from mpi4dl_tpu.obs.scopes import scope
+
+        def junction(x):
+            with scope("junction_gather"):
+                return lax.all_gather(x, "spw", axis=1, tiled=True)  # analysis: ok(unquantized-collective) — exact by design
+        """,
+        rule="unquantized-collective",
+        filename="mpi4dl_tpu/parallel/fix.py",
+    )
+    assert vs == []
+
+
+def test_unquantized_collective_per_block_granularity(tmp_path):
+    """A quant-aware FUNCTION does not grandfather a second hot block
+    without its own quant path (the regression the rule exists for)."""
+    vs = _run(
+        tmp_path,
+        """
+        from jax import lax
+        from mpi4dl_tpu.obs.scopes import scope
+        from mpi4dl_tpu.quant.collectives import quantized_all_gather
+
+        def junction(x, quant=None):
+            with scope("junction_gather"):
+                if quant is not None:
+                    x = quantized_all_gather(x, "spw", 1, "int8", 256)
+                else:
+                    x = lax.all_gather(x, "spw", axis=1, tiled=True)
+            with scope("stage_lineup"):
+                return lax.all_gather(x, "stage", axis=0, tiled=True)
+        """,
+        rule="unquantized-collective",
+        filename="mpi4dl_tpu/parallel/fix.py",
+    )
+    assert len(vs) == 1 and "stage_lineup" in vs[0].message

@@ -5,6 +5,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+from conftest import skip_old_jax  # the shared old-jax version guard
+
 
 from mpi4dl_tpu.cells import split_even
 from mpi4dl_tpu.layer_ctx import ApplyCtx
@@ -68,6 +70,11 @@ def test_softmax_in_model_flag():
     np.testing.assert_allclose(float(jnp.sum(y)), 1.0, rtol=1e-5)
 
 
+@pytest.mark.skipif(
+    not hasattr(jax, "enable_x64"),
+    reason="known old-jax failure: jax.enable_x64 (top-level) missing on "
+           "the legacy 0.4.x line; auto-unskips when the API exists",
+)
 def test_lane_pad_function_preserving(monkeypatch):
     """MPI4DL_LANE_PAD=1 pads bottleneck mid-channels to 128 lanes with
     zero weights — losses, grads, and running stats must match the unpadded
@@ -140,6 +147,7 @@ def test_lane_pad_function_preserving(monkeypatch):
     )
 
 
+@skip_old_jax
 def test_amoebanet_fine_remat_packed_states_exact(monkeypatch):
     """remat='fine' (per-op checkpoints with lane-packed DAG states) must
     be bit-level equivalent to the no-remat path: packing is a reshape and
