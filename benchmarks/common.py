@@ -46,14 +46,45 @@ from mpi4dl_tpu.config import (
 from mpi4dl_tpu.utils import StepMeter
 
 
-def _spatial_levels(cfg: ParallelConfig, n_cells: int):
+def _resolve_spatial_until(cfg: ParallelConfig, n_cells: int, shapes):
+    """Resolve cfg.spatial_until to a concrete junction cell (or None when
+    unset): an explicit int is clamped to the legal [1, n_cells-1] range;
+    ``"auto"`` asks the analytical placement frontier
+    (parallel/spatial.choose_spatial_until) — the ``mem_probe
+    --sweep-junction`` chooser running as default config."""
+    su = cfg.spatial_until
+    if su is None:
+        return None
+    if su == "auto":
+        import jax.numpy as jnp
+
+        from mpi4dl_tpu.parallel.spatial import choose_spatial_until
+
+        assert shapes is not None, "--spatial-until auto needs cell shapes"
+        tiles = cfg.spatial_part_size
+        itemsize = jnp.dtype(cfg.compute_dtype).itemsize
+        su = choose_spatial_until(shapes, tiles, itemsize=itemsize)
+        print(f"note: --spatial-until auto resolved to {su} "
+              f"(analytical placement frontier, {tiles} tiles)")
+    clamped = max(1, min(int(su), n_cells - 1))
+    if clamped != int(su):
+        # Placement is the dominant memory lever (PERF_NOTES: su=18 vs 22
+        # is 123.9 vs 59.4 GB) — never re-place a pinned junction silently.
+        print(f"note: --spatial-until {su} clamped to {clamped} "
+              f"({n_cells}-cell model)")
+    return clamped
+
+
+def _spatial_levels(cfg: ParallelConfig, n_cells: int, shapes=None):
     """[(stop_cell, SpatialCtx)] for the spatial region.
 
     Level i covers the cells of pipeline split i (reference: the first
     `spatial_size` splits run conv_spatial, resnet_spatial.py:272-296) with
     `num_spatial_parts[i]` tiles (multi-level SP, train_spatial.py:453-504);
     a short parts list repeats its last element, and consecutive levels with
-    identical grids merge (no respatial between them)."""
+    identical grids merge (no respatial between them).  ``cfg.spatial_until``
+    (int or "auto") overrides the junction placement derived from the
+    splits."""
     from mpi4dl_tpu.cells import split_even
     from mpi4dl_tpu.layer_ctx import spatial_levels_for
 
@@ -96,6 +127,19 @@ def _spatial_levels(cfg: ParallelConfig, n_cells: int):
             levels[-1] = (stop, ctxs[i])
         elif stop > (levels[-1][0] if levels else 0):
             levels.append((stop, ctxs[i]))
+    su = _resolve_spatial_until(cfg, n_cells, shapes)
+    if su is not None:
+        # Re-place the junction: clamp the level chain at the new stop
+        # (dropping levels that now start past it) or extend the last level
+        # to reach it — interior level boundaries keep their positions.
+        clamped = []
+        for stop, c in levels:
+            prev = clamped[-1][0] if clamped else 0
+            if prev >= su:
+                break
+            clamped.append((min(stop, su), c))
+        clamped[-1] = (su, clamped[-1][1])
+        levels = clamped
     return levels
 
 
@@ -112,8 +156,18 @@ def build_train(cfg: ParallelConfig, family: str, mesh):
 
     from mpi4dl_tpu.quant import QuantPolicy
 
+    if cfg.stripe_bwd:
+        # The stripe-wise backward is dispatched at trace time off the
+        # MPI4DL_STRIPE_BWD hatch (like the other layer-dispatch hatches);
+        # the config flag sets it for this process before any step builds.
+        # Deliberately NOT cleared when cfg.stripe_bwd is false: tracing
+        # happens after build_train returns, and the env-var hatch is a
+        # documented interface of its own (HATCHES) — an in-process
+        # striped-vs-plain A/B must manage the variable itself (as the
+        # tests do via monkeypatch).
+        os.environ["MPI4DL_STRIPE_BWD"] = "1"
     model = build_model(cfg)
-    params, _ = model.init(jax.random.key(cfg.seed))
+    params, shapes = model.init(jax.random.key(cfg.seed))
     opt = Optimizer(cfg.optimizer, lr=cfg.lr, momentum=cfg.momentum)
     dp = cfg.data_parallel
     dtype = cfg.compute_dtype
@@ -200,7 +254,7 @@ def build_train(cfg: ParallelConfig, family: str, mesh):
         )
 
     # Spatial families
-    levels = _spatial_levels(cfg, len(model.cells))
+    levels = _spatial_levels(cfg, len(model.cells), shapes=shapes)
     sp = levels[0][1]
     model.spatial_until = levels[-1][0]
     junction = "batch_split" if cfg.local_dp_lp > 1 else "gather"

@@ -272,7 +272,18 @@ def _sweep_junction(args) -> dict:
     n_cells = len(model.cells)
 
     if args.junction_levels:
-        levels = [int(s) for s in args.junction_levels.split(",")]
+        asked = [int(s) for s in args.junction_levels.split(",")]
+        # At least one spatial cell, at least one tail cell (the head can
+        # never run tiled) — out-of-range candidates are dropped, not
+        # crashed on (the fixed CI list must survive model-size changes).
+        levels = [su for su in asked if 1 <= su <= n_cells - 1]
+        if levels != asked:
+            print(
+                f"[mem_probe] note: junction levels {asked} clamped to "
+                f"legal placements {levels} ({n_cells}-cell model)",
+                file=sys.stderr,
+            )
+        assert levels, f"no legal junction level in {asked}"
     else:
         # Every legal placement: at least one spatial cell, at least one
         # tail cell (the head can never run tiled).
@@ -337,6 +348,23 @@ def _sweep_junction(args) -> dict:
     # "Naive" = the deepest spatial region probed (ROADMAP item 1's config
     # A), regardless of the order --junction-levels listed the candidates.
     naive = max(placements, key=lambda p: p["spatial_until"])
+    # The analytical chooser's pick, recorded next to the compiled frontier
+    # so the --spatial-until auto default stays validated by the sweep.
+    from mpi4dl_tpu.parallel.spatial import choose_spatial_until
+
+    auto_su = choose_spatial_until(shapes, g, itemsize=4)
+    auto_row = next(
+        (p for p in placements if p["spatial_until"] == auto_su), None
+    )
+    auto_choice = {
+        "spatial_until": auto_su,
+        "in_probed_frontier": auto_row is not None,
+        "peak_gb_est": auto_row["peak_gb_est"] if auto_row else None,
+        "over_best": (
+            round(auto_row["peak_gb_est"] / best["peak_gb_est"], 3)
+            if auto_row and best["peak_gb_est"] else None
+        ),
+    }
     return {
         "metric": "junction_frontier_peak_gb",
         "value": best["peak_gb_est"],
@@ -347,6 +375,7 @@ def _sweep_junction(args) -> dict:
         "placements": placements,
         "best": {k: best[k] for k in ("spatial_until", "peak_gb_est")},
         "naive": {k: naive[k] for k in ("spatial_until", "peak_gb_est")},
+        "auto_choice": auto_choice,
         "naive_over_best": (
             round(naive["peak_gb_est"] / best["peak_gb_est"], 3)
             if best["peak_gb_est"] else None
@@ -412,12 +441,19 @@ def _parts_delta(args, out) -> dict:
         growth = growth_groups(
             row["hbm"], row_b["hbm"], args.parts, args.delta_parts
         )
+        dparts = args.delta_parts - args.parts
         delta["per_schedule"][sched] = {
             "growth_bytes_per_part": growth,
             "top_growth_group": top_growth_group(growth),
             "peak_delta_bytes": compare_breakdowns(
                 row["hbm"], row_b["hbm"]
             )["peak_delta_bytes"],
+            # The compiled (memory_analysis) per-part slope — the number the
+            # --require-delta-slope ceiling gates; the growth ledger above
+            # rides the attribution ESTIMATE and only names the owner.
+            "peak_slope_gb_per_part": round(
+                (row_b["peak_gb_est"] - row["peak_gb_est"]) / dparts, 3
+            ),
         }
         print(
             f"[mem_probe] {args.family}/{sched} growth "
@@ -543,6 +579,16 @@ def main(argv=None) -> int:
                         "with the largest positive per-part growth starts "
                         "with one of these comma-separated prefixes "
                         "(e.g. 'sp_region,junction,stage_lineup')")
+    p.add_argument("--require-delta-slope", type=float, default=None,
+                   metavar="GB",
+                   help="with --delta-parts: exit 1 when the TOTAL per-part "
+                        "peak-HBM slope exceeds this many GB/device/part on "
+                        "any probed schedule — the stripe-backward O(parts) "
+                        "buy-back's regression ceiling (docs/pipeline.md)")
+    p.add_argument("--stripe-bwd", action="store_true",
+                   help="sets MPI4DL_STRIPE_BWD=1 for the probed engines: "
+                        "stripe-wise backward through eligible blocks "
+                        "(ops/stripe_bwd.py)")
     p.add_argument("--sweep-junction", action="store_true",
                    help="sweep the SP->LP junction placement (spatial_until)"
                         " and emit the placement frontier artifact")
@@ -565,11 +611,19 @@ def main(argv=None) -> int:
     if not args.attribute and (
         args.min_coverage is not None or args.require_attrib_top
         or args.delta_parts is not None or args.require_delta_top
+        or args.require_delta_slope is not None
     ):
         print("[mem_probe] --min-coverage/--require-attrib-top/"
-              "--delta-parts/--require-delta-top need --attribute",
+              "--delta-parts/--require-delta-top/--require-delta-slope "
+              "need --attribute", file=sys.stderr)
+        return 2
+    if args.require_delta_slope is not None and args.delta_parts is None:
+        print("[mem_probe] --require-delta-slope needs --delta-parts "
+              "(the slope is measured between the two part counts)",
               file=sys.stderr)
         return 2
+    if args.stripe_bwd:
+        os.environ["MPI4DL_STRIPE_BWD"] = "1"
     if args.require_hidden_frac is not None and not args.overlap:
         print("[mem_probe] --require-hidden-frac needs --overlap",
               file=sys.stderr)
@@ -692,6 +746,31 @@ def main(argv=None) -> int:
                 print("[mem_probe] FAIL: --require-delta-top with no "
                       "parts-delta rows (need --delta-parts + --attribute "
                       "in family mode)", file=sys.stderr)
+            return 1
+    if args.require_delta_slope is not None:
+        rows_d = (out.get("parts_delta") or {}).get("per_schedule") or {}
+        fails = 0
+        for sched, d in rows_d.items():
+            slope = d.get("peak_slope_gb_per_part")
+            if slope is None or slope > args.require_delta_slope:
+                print(
+                    f"[mem_probe] FAIL {args.family}/{sched}: per-part "
+                    f"peak-HBM slope {slope} GB/part exceeds "
+                    f"--require-delta-slope {args.require_delta_slope}",
+                    file=sys.stderr,
+                )
+                fails += 1
+            else:
+                print(
+                    f"[mem_probe] OK {args.family}/{sched}: per-part "
+                    f"peak-HBM slope {slope} GB/part <= "
+                    f"{args.require_delta_slope}",
+                    file=sys.stderr,
+                )
+        if fails or not rows_d:
+            if not rows_d:
+                print("[mem_probe] FAIL: --require-delta-slope with no "
+                      "parts-delta rows", file=sys.stderr)
             return 1
     if args.require_1f1b_win:
         win = out.get("win_1f1b_gb")

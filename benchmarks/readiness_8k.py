@@ -35,6 +35,8 @@ sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
 
+from mpi4dl_tpu.config import _spatial_until_arg  # noqa: E402
+
 V5P_HBM_GB = 95.0
 V5E_HBM_GB = 16.0
 
@@ -50,9 +52,27 @@ def main(argv=None) -> int:
                         "micro-batches to O(stages); docs/pipeline.md)")
     p.add_argument("--num-layers", type=int, default=18)
     p.add_argument("--num-filters", type=int, default=416)
-    p.add_argument("--spatial-until", type=int, default=9,
+    p.add_argument("--spatial-until", default="9",
+                   type=_spatial_until_arg,
                    help="cells in the spatial region (stems + first normal "
-                        "group by default — the high-resolution cells)")
+                        "group by default — the high-resolution cells), or "
+                        "'auto' to resolve the junction placement from the "
+                        "analytical frontier "
+                        "(parallel/spatial.choose_spatial_until)")
+    p.add_argument("--spatial-parts", default=None, metavar="N[,N...]",
+                   help="multi-level spatial chain (square grids), e.g. "
+                        "'64,16' = SP(8x8) head levels coarsening to 4x4 "
+                        "via the gather-free respatial fast paths; "
+                        "overrides --tiles (level-0 grid = sqrt(N0))")
+    p.add_argument("--stripe-bwd", action="store_true",
+                   help="sets MPI4DL_STRIPE_BWD=1: stripe-wise backward "
+                        "through the SP-region blocks (the O(parts) "
+                        "buy-back; docs/pipeline.md)")
+    p.add_argument("--require-gb", type=float, default=None,
+                   help="exit 1 if the compiled per-device HBM demand "
+                        "exceeds this many GB (the spatial-stripe-memory "
+                        "CI gate: < 95 GB at 8192² parts=8 with "
+                        "--stripe-bwd on)")
     p.add_argument("--attribute", action="store_true",
                    help="add the per-obs.scope HBM breakdown + analytical "
                         "timeline + exposed-wire overlap ledger (obs/hbm.py,"
@@ -81,6 +101,21 @@ def main(argv=None) -> int:
         p.error("--require-wire-gb needs --attribute (the gate reads the "
                 "overlap ledger)")
 
+    if args.stripe_bwd:
+        os.environ["MPI4DL_STRIPE_BWD"] = "1"
+    spatial_parts = (
+        [int(s) for s in args.spatial_parts.split(",")]
+        if args.spatial_parts else None
+    )
+    if spatial_parts:
+        import math
+
+        g0 = math.isqrt(spatial_parts[0])
+        assert g0 * g0 == spatial_parts[0], (
+            f"--spatial-parts levels must be perfect squares, got "
+            f"{spatial_parts[0]}"
+        )
+        args.tiles = g0
     n_dev = args.tiles * args.tiles * args.stages
     import jax
 
@@ -113,23 +148,65 @@ def main(argv=None) -> int:
         (1, px, px, 3), num_classes=1000,
         num_layers=args.num_layers, num_filters=args.num_filters,
     )
-    model.spatial_until = min(args.spatial_until, len(model.cells) - 1)
     params, shapes = model.init(jax.random.key(0))
+    if args.spatial_until == "auto":
+        from mpi4dl_tpu.parallel.spatial import choose_spatial_until
+
+        # With --spatial-parts the proxy assumes the LEVEL-0 grid for the
+        # whole region; coarser levels hold a larger share, so the chosen
+        # placement is conservative (never deeper than the true optimum).
+        su = choose_spatial_until(shapes, t * t, itemsize=2)
+        print(f"[readiness] --spatial-until auto -> {su} "
+              f"(analytical placement frontier, {t}x{t} tiles)",
+              file=sys.stderr)
+    else:
+        su = int(args.spatial_until)
+    model.spatial_until = min(su, len(model.cells) - 1)
+    su = model.spatial_until
+
+    # --- spatial level chain (built before the ledger: per-cell tile
+    # counts depend on which level a cell lands in) ----------------------
+    sp = SpatialCtx(axis_h="sph", axis_w="spw", grid_h=t, grid_w=t)
+    levels = None
+    if spatial_parts:
+        # Multi-level spatial chain (e.g. SP(8x8) head coarsening to 4x4):
+        # square grids per level, level transitions via the gather-free
+        # respatial fast paths (PR 10), level stops splitting the spatial
+        # region evenly.
+        from mpi4dl_tpu.cells import split_even
+        from mpi4dl_tpu.layer_ctx import spatial_levels_for
+
+        ctxs = spatial_levels_for("square", spatial_parts)
+        sp = ctxs[0]
+        stops = [hi for _, hi in split_even(su, len(ctxs))]
+        levels = []
+        # Unlike benchmarks/common._spatial_levels there is no
+        # identical-grid merge case here: a square chain's grids shrink
+        # strictly level to level, so a stop collision (su < levels) just
+        # drops the coarser level.
+        for stop, c in zip(stops, ctxs):
+            if stop > (levels[-1][0] if levels else 0):
+                levels.append((stop, c))
+        levels[-1] = (su, levels[-1][1])
 
     # --- analytic ledger: per-device activation bytes from eval_shape ----
-    # Spatial cells carry H/t x W/t tiles; tail cells live on one stage.
-    su = model.spatial_until
+    # A spatial cell carries its LEVEL's tile fraction (multi-level chains
+    # coarsen the grid, so later cells hold a larger per-device share);
+    # tail cells live on one stage.
+    from mpi4dl_tpu.parallel.spatial import _cell_bytes
+
+    def _tiles_for(i: int) -> int:
+        if levels:
+            for stop, c in levels:
+                if i < stop:
+                    return c.grid_h * c.grid_w
+        return t * t
+
     ledger = {"spatial_cells": [], "tail_cells": []}
     for i, shp in enumerate(shapes):
-        shps = shp if isinstance(shp[0], tuple) else (shp,)
-        bytes_dev = 0
-        for s in shps:
-            n = 1
-            for d in s:
-                n *= d
-            if i < su:
-                n //= t * t
-            bytes_dev += n * 2  # bf16
+        bytes_dev = _cell_bytes(shp, 2)  # bf16
+        if i < su:
+            bytes_dev //= _tiles_for(i)
         (ledger["spatial_cells"] if i < su else ledger["tail_cells"]).append(
             {"cell": i, "per_device_mb": round(bytes_dev / 2**20, 1)}
         )
@@ -137,7 +214,6 @@ def main(argv=None) -> int:
     tail_sum = sum(c["per_device_mb"] for c in ledger["tail_cells"])
 
     # --- build + compile the flagship program at real shapes -------------
-    sp = SpatialCtx(axis_h="sph", axis_w="spw", grid_h=t, grid_w=t)
     mesh = build_mesh(
         MeshSpec(data=1, stage=S, sph=t, spw=t), jax.devices()[:n_dev]
     )
@@ -146,7 +222,7 @@ def main(argv=None) -> int:
     # gather junction: batch_split needs microbatch % tiles² == 0, which
     # bs1 (the north-star config) cannot satisfy.
     spp = SPPipeline.build(model, params, S, sp, microbatch=1,
-                           junction="gather")
+                           junction="gather", levels=levels)
     step = make_sp_pipeline_train_step(
         spp, opt, mesh, parts=args.parts, compute_dtype=jnp.bfloat16,
         remat=True, donate=True, schedule=args.schedule, quant=quant,
@@ -190,6 +266,10 @@ def main(argv=None) -> int:
             "image_size": px, "grid": f"{t}x{t}", "stages": S,
             "parts": args.parts, "schedule": args.schedule,
             "devices": n_dev,
+            "spatial_until": model.spatial_until,
+            "spatial_parts": spatial_parts,
+            "stripe_bwd": bool(args.stripe_bwd
+                               or os.environ.get("MPI4DL_STRIPE_BWD") == "1"),
             "model": f"amoebanetd({args.num_layers},{args.num_filters})",
             "quant": quant.spec() if quant else "off",
         },
@@ -211,6 +291,15 @@ def main(argv=None) -> int:
             ),
         },
     }
+    if args.require_gb is not None:
+        ok = per_dev_gb < args.require_gb
+        out["hbm_gate"] = {"limit_gb": args.require_gb, "ok": ok}
+        print(
+            f"[readiness] HBM gate {'ok' if ok else 'FAILED'}: "
+            f"{per_dev_gb:.2f} GB/device "
+            f"{'<' if ok else '>='} --require-gb {args.require_gb}",
+            file=sys.stderr,
+        )
     breakdown = timeline = ledger = None
     if args.attribute:
         from mpi4dl_tpu.obs import (
@@ -302,6 +391,8 @@ def main(argv=None) -> int:
         print(f"[readiness] telemetry written to {runlog.path}",
               file=sys.stderr)
     if not out.get("wire_gate", {}).get("ok", True):
+        return 1
+    if not out.get("hbm_gate", {}).get("ok", True):
         return 1
     return 0
 

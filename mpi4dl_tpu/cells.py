@@ -59,8 +59,15 @@ class LayerCell(Cell):
 
     def apply(self, params, x, ctx):
         from mpi4dl_tpu.ops.d2 import maybe_run_d2, maybe_run_fused_unsharded
+        from mpi4dl_tpu.ops.stripe_bwd import maybe_stripe_run
 
         y = maybe_run_d2(self.layers, params, x, ctx)
+        if y is not None:
+            return y
+        # Stripe-wise execution (MPI4DL_STRIPE_BWD=1): the whole cell runs —
+        # forward and backward — one H-stripe at a time under pad-once
+        # margins (ops/stripe_bwd.py; the flagship's O(parts) buy-back).
+        y = maybe_stripe_run(self.layers, params, x, ctx)
         if y is not None:
             return y
         y = maybe_run_fused_unsharded(self.layers, params, x, ctx)

@@ -62,7 +62,23 @@ HATCHES: Dict[str, Hatch] = {
               "train-mode BN stats warning), auto = on with warning."),
         Hatch("MPI4DL_HSTRIPE_EXACT", "0",
               "1 = striped train-mode BN uses GLOBAL batch statistics "
-              "(exactness at ~1 extra prefix forward per BN)."),
+              "(exactness at ~1 extra prefix forward per BN; applies to "
+              "both the single-device striped run and the stripe-wise "
+              "backward)."),
+        Hatch("MPI4DL_STRIPE_BWD", "0",
+              "Stripe-wise forward+backward through eligible stride-1 "
+              "blocks (ops/stripe_bwd.py): 1 = spatially sharded blocks "
+              "only (the SP region — tail cells excluded: striped scans "
+              "inside the 1F1B branch conditionals regress peak HBM "
+              "several-fold), all = every eligible block (exactness "
+              "testing).  The accumulated halo is realized once, then a "
+              "jax.checkpoint'd scan over H stripes bounds the BACKWARD "
+              "working set to one stripe — the SP-region O(parts) buy-back "
+              "at the 8K flagship (docs/pipeline.md)."),
+        Hatch("MPI4DL_STRIPE_BUDGET", str(64 * 1024 * 1024),
+              "Per-stripe working-set budget in bytes for the stripe-wise "
+              "backward (widest intermediate per stripe, whole chunk); "
+              "the stripe count is derived from it."),
         Hatch("MPI4DL_NO_PACK", "0",
               "1 = disable boundary packing of D2 fused-run margins "
               "(A/B hatch; measured a no-op on v5e — PERF_NOTES r5)."),
@@ -187,6 +203,16 @@ class ParallelConfig:
     # mpi4dl_tpu.quant.QuantPolicy.resolve (the MPI4DL_QUANT_COLLECTIVES
     # hatch overrides).  Off is bit-identical to the unquantized engines.
     quant_collectives: str = "off"
+    # Stripe-wise backward through eligible stride-1 blocks (sets the
+    # MPI4DL_STRIPE_BWD hatch for this process at build time): the SP-region
+    # O(parts) buy-back — docs/pipeline.md, ops/stripe_bwd.py.
+    stripe_bwd: bool = False
+    # SP→LP junction placement: None = derive from the pipeline splits (the
+    # historical behaviour), an int = explicit junction cell, "auto" =
+    # resolve from the analytical placement frontier
+    # (parallel/spatial.choose_spatial_until — the mem_probe
+    # --sweep-junction frontier promoted to the default config chooser).
+    spatial_until: Optional[object] = None
     verbose: bool = False  # debug logging (reference parser.py --verbose)
     checkpoint_dir: Optional[str] = None
     seed: int = 0
@@ -234,6 +260,10 @@ class ParallelConfig:
         assert self.batch_size % self.parts == 0, "batch must divide into parts"
         if self.balance is not None:
             assert len(self.balance) == self.split_size
+        if self.spatial_until is not None:
+            assert self.spatial_until == "auto" or (
+                isinstance(self.spatial_until, int) and self.spatial_until >= 1
+            ), f"--spatial-until must be 'auto' or an int >= 1, got {self.spatial_until!r}"
         # Fail fast on a malformed quant spec (raises ValueError with the
         # offending token; the hatch override is resolved at build time).
         from mpi4dl_tpu.quant.policy import QuantPolicy
@@ -325,6 +355,17 @@ def get_parser() -> argparse.ArgumentParser:
                         "bit-identical), int8|fp8|int4 for every hot class, "
                         "or per-class junction=...,respatial=...,grad=...,"
                         "handoff=...[,block=N] (docs/quantization.md)")
+    p.add_argument("--stripe-bwd", action="store_true",
+                   help="stripe-wise forward+backward through eligible "
+                        "stride-1 blocks (sets MPI4DL_STRIPE_BWD=1): bounds "
+                        "the SP-region backward working set to one H-stripe "
+                        "— the O(parts) buy-back (docs/pipeline.md)")
+    p.add_argument("--spatial-until", default=None, metavar="N|auto",
+                   type=_spatial_until_arg,
+                   help="SP->LP junction placement: an explicit cell index, "
+                        "or 'auto' to resolve it from the analytical "
+                        "placement frontier (the mem_probe --sweep-junction "
+                        "chooser); default: derive from the pipeline splits")
     p.add_argument("--checkpoint-dir", type=str, default=None)
     p.add_argument("--seed", type=int, default=0)
     return p
@@ -334,6 +375,15 @@ def _int_tuple(s: Optional[str]) -> Optional[Tuple[int, ...]]:
     if s is None or s == "":
         return None
     return tuple(int(x) for x in s.split(","))
+
+
+def _spatial_until_arg(s):
+    """Parse --spatial-until: None, 'auto', or an int."""
+    if s is None or s == "":
+        return None
+    if s == "auto":
+        return "auto"
+    return int(s)
 
 
 def config_from_args(args: argparse.Namespace) -> ParallelConfig:
@@ -369,6 +419,8 @@ def config_from_args(args: argparse.Namespace) -> ParallelConfig:
         remat=not args.no_remat,
         pallas_conv=args.pallas_conv,
         quant_collectives=getattr(args, "quant_collectives", "off"),
+        stripe_bwd=getattr(args, "stripe_bwd", False),
+        spatial_until=_spatial_until_arg(getattr(args, "spatial_until", None)),
         verbose=getattr(args, "verbose", False),
         checkpoint_dir=args.checkpoint_dir,
         seed=args.seed,

@@ -134,6 +134,14 @@ class ResBlockV1(Cell):
             x,
             ctx,
         )
+        if y is None and self.stride == 1:
+            from mpi4dl_tpu.ops.stripe_bwd import maybe_stripe_run
+
+            y = maybe_stripe_run(
+                list(self.r1.layers) + list(self.r2.layers),
+                list(params["r1"]) + list(params["r2"]),
+                x, ctx,
+            )
         if y is None:
             y = _apply_branch(
                 (self.r1, self.r2), (params["r1"], params["r2"]), x, ctx
@@ -201,6 +209,15 @@ class ResBlockV2(Cell):
         )
         # D2: one halo exchange for the whole bottleneck (3x3 + 3x3 + 1x1).
         y = maybe_run_d2(branch_layers, branch_params, x, ctx)
+        if y is None and self.stride == 1:
+            # Stripe-wise fwd+bwd for the whole bottleneck branch — ONE
+            # accumulated halo realization, then a checkpointed scan over H
+            # stripes whose transpose re-executes each stripe in place
+            # (ops/stripe_bwd.py; MPI4DL_STRIPE_BWD=1).  Dispatched at the
+            # branch so the three sub-runs share a single exchange.
+            from mpi4dl_tpu.ops.stripe_bwd import maybe_stripe_run
+
+            y = maybe_stripe_run(branch_layers, branch_params, x, ctx)
         if y is None and self.stride == 1 and _hstripe_enabled():
             # Single-device huge-spatial blocks run the branch H-stripe by
             # H-stripe (ops/hstripe_conv.hstripe_layer_run) so the branch's

@@ -379,6 +379,59 @@ def apply_spatial_region(
     return x, prev
 
 
+def _cell_bytes(shape, itemsize: int) -> int:
+    """Total activation bytes of one cell's (possibly tuple) output shape."""
+    shapes = shape if isinstance(shape[0], (tuple, list)) else (shape,)
+    total = 0
+    for s in shapes:
+        n = 1
+        for d in s:
+            n *= int(d)
+        total += n * itemsize
+    return total
+
+
+def spatial_cost_ledger(shapes, tiles: int, itemsize: int = 2):
+    """Per-placement analytical activation cost — the ``mem_probe
+    --sweep-junction`` frontier's analytic half as a pure function.
+
+    ``shapes``: per-cell global OUTPUT shapes (``CellModel.init``'s second
+    return).  For each candidate junction placement ``su`` the per-device
+    proxy is: cells before the junction carry 1/``tiles`` of their bytes
+    (spatially sharded), cells at/after it carry full bytes (the
+    junction='gather' tail is replicated per tile device — the flagship's
+    configuration; batch_split divides both sides equally and preserves the
+    argmin).  The head cell (global pool → per-image vectors) is excluded:
+    it can never run tiled and its bytes are placement-independent.
+
+    Returns ``{su: bytes}`` over every legal placement ``1 <= su <
+    len(shapes) - 1``."""
+    n_cells = len(shapes)
+    b = [_cell_bytes(s, itemsize) for s in shapes]
+    out = {}
+    for su in range(1, n_cells - 1):
+        spatial = sum(b[i] for i in range(su)) / tiles
+        tail = sum(b[i] for i in range(su, n_cells - 1))
+        out[su] = spatial + tail
+    return out
+
+
+def choose_spatial_until(shapes, tiles: int, itemsize: int = 2) -> int:
+    """The ``--spatial-until auto`` chooser: resolve the SP→LP junction
+    placement from the analytical frontier (ROADMAP item 1: the measured
+    naive-vs-tuned gap at 8K was 370 vs 116.7 GB/device for placement
+    alone — this makes the tuned choice the default instead of a report).
+
+    Picks the placement minimizing :func:`spatial_cost_ledger`'s per-device
+    activation proxy; ties go to the DEEPER placement (more cells tiled —
+    at equal activation cost, later junctions move less wire because the
+    gathered tensor is smaller).  Validated against the compiled frontier
+    by ``mem_probe --sweep-junction`` (the artifact records both)."""
+    ledger = spatial_cost_ledger(shapes, tiles, itemsize)
+    best = min(sorted(ledger), key=lambda su: (ledger[su], -su))
+    return best
+
+
 def apply_spatial_model(
     model: CellModel,
     params_list,

@@ -1,0 +1,171 @@
+"""SP(8×8) geometry end-to-end + the analytical junction-placement chooser.
+
+The 8×8 tile grid is the flagship's next spatial rung (ROADMAP item 1:
+quarter the per-part spatial cost again after SP(4×4)).  Tier-1 pins the
+geometry math — 64-tile square contexts, multi-level "64,16" chains whose
+coarsening rides the PR-10 gather-free respatial fast paths, the
+`--spatial-until auto` chooser, and the config plumbing.  The slow lane
+compiles a real multi-level SP(8×8)×PP(2) train step on a 128-virtual-
+device mesh in a subprocess (the pytest session's backend is pinned to 8
+devices, so the big mesh needs its own process)."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mpi4dl_tpu.config import ParallelConfig, config_from_args, get_parser
+from mpi4dl_tpu.layer_ctx import spatial_levels_for
+from mpi4dl_tpu.mesh import AXIS_SPH, AXIS_SPW, MeshSpec
+from mpi4dl_tpu.parallel.spatial import (
+    choose_spatial_until,
+    spatial_cost_ledger,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_square_64_ctx():
+    """slice_method='square' with 64 parts is an 8×8 grid on (sph, spw)."""
+    [sp] = spatial_levels_for("square", [64])
+    assert (sp.axis_h, sp.axis_w) == (AXIS_SPH, AXIS_SPW)
+    assert (sp.grid_h, sp.grid_w) == (8, 8)
+    assert (sp.rep_h, sp.rep_w) == (1, 1)
+    assert sp.active
+
+
+def test_multilevel_64_16_4_chain():
+    """The '64,16,4' chain: 8×8 → 4×4 (rep 2×2) → 2×2 (rep 4×4); every
+    level embeds in the base grid, and every coarsening step divides —
+    exactly the shape the gather-free coarsen-ring fast path takes."""
+    lv = spatial_levels_for("square", [64, 16, 4])
+    grids = [(sp.grid_h, sp.grid_w, sp.rep_h, sp.rep_w) for sp in lv]
+    assert grids == [(8, 8, 1, 1), (4, 4, 2, 2), (2, 2, 4, 4)], grids
+    for sp in lv:
+        assert sp.grid_h * sp.rep_h == 8 and sp.grid_w * sp.rep_w == 8
+
+
+def test_mesh_spec_sp8x8():
+    cfg = ParallelConfig(num_spatial_parts=(64,), spatial_size=1,
+                         split_size=2, image_size=512, batch_size=2, parts=2)
+    cfg.validate()
+    spec = MeshSpec.from_config(cfg)
+    assert (spec.sph, spec.spw, spec.stage) == (8, 8, 2)
+    assert spec.size == 128
+
+
+def test_config_spatial_until_flag_parse():
+    p = get_parser()
+    cfg = config_from_args(p.parse_args(
+        ["--spatial-until", "auto", "--batch-size", "4"]))
+    assert cfg.spatial_until == "auto"
+    cfg = config_from_args(p.parse_args(
+        ["--spatial-until", "7", "--batch-size", "4"]))
+    assert cfg.spatial_until == 7
+    cfg = config_from_args(p.parse_args(["--batch-size", "4"]))
+    assert cfg.spatial_until is None
+    with pytest.raises(SystemExit):
+        p.parse_args(["--spatial-until"])  # missing value
+
+
+def test_config_stripe_bwd_flag():
+    p = get_parser()
+    cfg = config_from_args(p.parse_args(["--stripe-bwd", "--batch-size", "4"]))
+    assert cfg.stripe_bwd
+    assert not config_from_args(p.parse_args(["--batch-size", "4"])).stripe_bwd
+
+
+# ---------------------------------------------------------------------------
+# The analytical placement chooser
+# ---------------------------------------------------------------------------
+
+
+def test_spatial_cost_ledger_hand_computed():
+    """3 cells (2 candidate placements): hand-computed per-device proxy.
+    Head cell (index 2) is excluded from both sides."""
+    shapes = [(1, 8, 8, 4), (1, 4, 4, 8), (1, 10)]
+    led = spatial_cost_ledger(shapes, tiles=4, itemsize=2)
+    b0 = 8 * 8 * 4 * 2
+    b1 = 4 * 4 * 8 * 2
+    assert led == {1: b0 / 4 + b1}
+    led2 = spatial_cost_ledger(shapes + [(1, 10)], tiles=4, itemsize=2)
+    assert led2[2] == b0 / 4 + b1 / 4 + 10 * 2
+
+
+def test_choose_spatial_until_is_argmin():
+    """The chooser returns the ledger argmin (brute force), with ties to
+    the deeper placement."""
+    shapes = [(1, 64, 64, 4)] * 5 + [(1, 10)]
+    led = spatial_cost_ledger(shapes, tiles=16)
+    su = choose_spatial_until(shapes, tiles=16)
+    assert led[su] == min(led.values())
+    # equal-bytes cells: every placement but the deepest leaves un-tiled
+    # full-res cells on the table, so the chooser must go deepest.
+    assert su == len(shapes) - 2
+
+
+def test_choose_spatial_until_flagship_shape():
+    """On an AmoebaNet-D-like shrinking pyramid the chooser puts the
+    junction where the resolution has collapsed — past the high-resolution
+    cells, never at the stem."""
+    from mpi4dl_tpu.models.amoebanet import amoebanetd
+
+    model = amoebanetd((1, 1024, 1024, 3), num_classes=10,
+                       num_layers=6, num_filters=64)
+    import jax
+
+    _, shapes = model.init(jax.random.key(0))
+    su = choose_spatial_until(shapes, tiles=16, itemsize=2)
+    n = len(model.cells)
+    assert 3 <= su <= n - 1, (su, n)
+    led = spatial_cost_ledger(shapes, tiles=16, itemsize=2)
+    assert led[su] == min(led.values())
+    # the naive deepest placement must not beat it by construction
+    assert led[su] <= led[n - 2]
+
+
+# ---------------------------------------------------------------------------
+# Slow: real SP(8×8) multi-level compile on a 128-virtual-device mesh
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_sp8x8_multilevel_compiles(tmp_path):
+    """readiness_8k --spatial-parts 64,16: an SP(8×8)×PP(2) multi-level
+    train step (respatial 8×8→4×4 riding the coarsen-ring fast path)
+    lowers, compiles, and reports per-device memory on a 128-virtual-
+    device mesh — the end-to-end SP(8×8) landing.  Subprocess: the pytest
+    backend is pinned to 8 devices."""
+    out = tmp_path / "sp8x8.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("MPI4DL_STRIPE_BWD", None)
+    # The pytest session pins its own host platform to 8 devices (conftest
+    # ensure_host_device_count mutates XLA_FLAGS, which the child inherits,
+    # and compat's fallback won't touch a flag that is already set) — strip
+    # it so the child can size a 128-device platform for itself.
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count")
+    )
+    r = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "benchmarks", "readiness_8k.py"),
+         "--image-size", "512", "--spatial-parts", "64,16", "--stages", "2",
+         "--parts", "2", "--num-layers", "6", "--num-filters", "64",
+         "--spatial-until", "4", "--schedule", "1f1b", "--out", str(out)],
+        env=env, capture_output=True, text=True, timeout=1500,
+    )
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    d = json.loads(out.read_text())
+    assert d["config"]["devices"] == 128
+    assert d["config"]["grid"] == "8x8"
+    assert d["config"]["spatial_parts"] == [64, 16]
+    assert d["value"] > 0
+    # the multi-level chain's respatial must appear in the compiled wire
+    assert any("ppermute" in k or "collective" in k or "all_gather" in k
+               for k in d["collectives_per_step"]), d["collectives_per_step"]
