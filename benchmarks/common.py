@@ -461,21 +461,48 @@ def run(family: str, model: str, argv=None) -> dict:
     ckpt_mgr = None
     start_step = 0
     if cfg.checkpoint_dir:
-        from mpi4dl_tpu.checkpoint import CheckpointManager, config_fingerprint
+        from mpi4dl_tpu.checkpoint import (
+            CheckpointManager, config_fingerprint, split_config_fingerprint,
+        )
+        from mpi4dl_tpu.quant import QuantPolicy
 
-        # steps_per_epoch is fingerprinted too: it defines the global-step →
-        # batch-index mapping and the checkpoint cadence, so resuming with a
-        # different value would replay different data while claiming the
-        # bit-identical-resume contract.
+        # steps_per_epoch is fingerprinted as model IDENTITY: it defines the
+        # global-step → batch-index mapping and the checkpoint cadence, so
+        # resuming with a different value would replay different data while
+        # claiming the bit-identical-resume contract.  The LAYOUT side
+        # (mesh, parts, schedule, spatial placement, quant/stripe policy —
+        # RESOLVED, so a hatch override is a recorded layout change, not
+        # silent drift) may differ between save and restore: elastic restore
+        # re-places every leaf under this run's mesh (docs/resilience.md).
+        quant_resolved = QuantPolicy.resolve(cfg.quant_collectives)
+        identity_fp, layout_fp, layout_desc = split_config_fingerprint(
+            cfg, spec,
+            extra_identity={"steps_per_epoch": args.steps_per_epoch},
+            extra_layout={
+                "quant_resolved": (
+                    quant_resolved.spec() if quant_resolved else "off"
+                ),
+                "stripe_bwd_resolved": os.environ.get(
+                    "MPI4DL_STRIPE_BWD", "0"
+                ),
+            },
+        )
         ckpt_mgr = CheckpointManager(
             cfg.checkpoint_dir,
             fingerprint=config_fingerprint(
                 cfg, spec, {"steps_per_epoch": args.steps_per_epoch}
             ),
+            identity=identity_fp, layout=layout_fp, layout_desc=layout_desc,
         )
         state, start_step = ckpt_mgr.restore_latest(state)
         if start_step:
             print(f"resuming from checkpoint step {start_step}")
+        if ckpt_mgr.last_restore is not None and ckpt_mgr.last_restore.elastic:
+            print(
+                "note: ELASTIC restore — checkpoint was saved under a "
+                f"different layout ({ckpt_mgr.last_restore.saved_layout}); "
+                "leaves re-placed under this run's mesh"
+            )
 
     dataset = make_dataset(cfg)
     steps = args.steps_per_epoch
@@ -490,6 +517,8 @@ def run(family: str, model: str, argv=None) -> dict:
             args.telemetry_dir, family, cfg, spec, step, state, dataset,
             global_batch, argv,
         )
+        if ckpt_mgr is not None and ckpt_mgr.last_restore is not None:
+            runlog.write("restore", **ckpt_mgr.last_restore.record())
 
     # The supervised loop (mpi4dl_tpu/resilience/loop.py) owns the epoch
     # structure: anomaly guard + rollback, preemption-safe checkpointing
@@ -541,7 +570,12 @@ def run(family: str, model: str, argv=None) -> dict:
         "loss": result.metrics.get("loss", float("nan")),
         "steps": len(meter.times_ms),
         "final_step": result.final_step,
+        "start_step": start_step,
         "preempted": result.preempted,
         "anomalies": result.anomalies,
+        "elastic": bool(
+            ckpt_mgr is not None and ckpt_mgr.last_restore is not None
+            and ckpt_mgr.last_restore.elastic
+        ),
         "telemetry_path": runlog.path if runlog is not None else None,
     }

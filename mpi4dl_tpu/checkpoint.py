@@ -3,29 +3,60 @@
 The reference has NO checkpointing anywhere (no torch.save/load in the repo —
 SURVEY §5 plans this as a new capability, not parity).  Design: any training
 state — TrainState, PipelineState, SPPipelineState, all registered dataclass
-pytrees — is flattened to leaves and written as one .npz; restore maps leaves
-back into a TEMPLATE state of the same structure (the state freshly built by
-the step builders), so no pytree schema needs serializing.  Sharded arrays
-round-trip through jax.device_get / device_put with the template's sharding,
-which makes resume bit-identical including flat stage buffers and optimizer
-state.
+pytrees — is flattened to leaves; restore maps leaves back into a TEMPLATE
+state of the same structure (the state freshly built by the step builders),
+so no pytree schema needs serializing.  Sharded arrays round-trip through
+the device runtime with the template's sharding, which makes resume
+bit-identical including flat stage buffers and optimizer state.
 
-Durability (ISSUE 3): every file embeds a ``__manifest__`` record — per-leaf
-CRC32, leaf shapes/dtypes, the step id, and an optional config/mesh
-fingerprint — and writes are tmp-file + fsync + atomic rename + directory
-fsync, so a killed run never leaves a torn checkpoint behind and silent
-corruption is detected at restore time rather than as a wrong-answer resume.
-:meth:`CheckpointManager.restore_latest` walks BACKWARD past torn or
-fingerprint-mismatched files to the newest *valid* checkpoint instead of
-raising — a corrupted newest file costs one checkpoint interval, not the run.
+Two on-disk formats:
+
+- **v1 (npz)**: one ``.npz`` holding every leaf as a full host array plus a
+  ``__manifest__`` record (per-leaf CRC32, shapes/dtypes, step id, config
+  fingerprint).  Kept for compatibility; ``restore_latest`` still reads it.
+- **v2 (sharded, ISSUE 13)**: a DIRECTORY ``ckpt_<step>/`` holding one raw
+  file per unique addressable shard, keyed by its GLOBAL offset, plus a
+  ``manifest.json`` (per-shard CRC32 + offsets + shapes, step id, split
+  identity/layout fingerprints).  The save path gathers shard-by-shard, so
+  peak host memory is O(largest shard), not O(full state), and restore can
+  reassemble each leaf from offsets and re-place it under a DIFFERENT mesh
+  layout (elastic restore — see below).  Same durability discipline as v1:
+  every shard file and the manifest are fsync'd inside a hidden tmp
+  directory, then one atomic directory rename + parent fsync publishes the
+  checkpoint; a killed run never leaves a torn checkpoint under the final
+  name.
+
+Elastic restore (ISSUE 13): the old single ``config_fingerprint`` hard-
+rejected ANY config difference, which made every geometry lever (mesh
+reshape, ``--spatial-until``, parts, quant policy) a checkpoint-orphaning
+event.  The fingerprint is now split:
+
+- **identity** — what the model IS (arch, sizes, seed, precision, data
+  addressing).  Must match; a mismatch is :class:`CheckpointMismatch`.
+- **layout** — where things live and how the step is scheduled (mesh shape,
+  spatial parts, ``spatial_until``, schedule, parts, quant policy, stripe
+  backward...).  May differ: on layout skew, each leaf is reassembled from
+  its global offsets on the host and ``device_put`` under the TARGET
+  template's shardings — a checkpoint saved under SP(2×2)×PP(2) restores
+  onto SP(4×1)×PP(2) and keeps training.  Only leaf-shape-preserving layout
+  changes are elastic; a layout change that alters leaf shapes (moving the
+  SP/PP junction of an sp_pipeline state re-packs the buffers) raises a
+  typed :class:`CheckpointMismatch` naming the offending leaf.
+
+``restore_latest`` walks BACKWARD past torn or mismatched files to the
+newest *valid* checkpoint.  The walk is MANIFEST-FIRST: each candidate is
+cheaply validated (manifest + fingerprints + leaf shapes vs the template +
+shard-file sizes — KBs of I/O) before any array bytes are read, so walking
+past a torn multi-GB checkpoint costs a stat pass, not a full read.
 
 The save path is split so the background writer
-(:class:`mpi4dl_tpu.resilience.writer.AsyncCheckpointWriter`) can run
-``device_get`` on the training thread (required: the next step donates the
-buffers) and serialization + fsync off it:
+(:class:`mpi4dl_tpu.resilience.writer.AsyncCheckpointWriter`) can run the
+device→host gathers on the training thread (required: the next step donates
+the buffers) and serialization + fsync off it:
 
-    :func:`state_to_arrays`  (training thread)  →
-    :func:`write_arrays`     (any thread)
+    v1:  :func:`state_to_arrays` (training thread) → :func:`write_arrays`
+    v2:  :func:`state_shard_plan` (training thread gathers each shard) →
+         :class:`ShardedSaveTxn` ``add_shard``/``commit`` (any thread)
 """
 
 from __future__ import annotations
@@ -37,29 +68,34 @@ import json
 import logging
 import os
 import re
+import shutil
 import tempfile
-from typing import Any, Dict, Optional, Tuple
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
 _CKPT_RE = re.compile(r"^ckpt_(\d+)\.npz$")
+_CKPT_DIR_RE = re.compile(r"^ckpt_(\d+)$")
 
 MANIFEST_KEY = "__manifest__"
 STEP_KEY = "__step_id__"
 MANIFEST_SCHEMA = 1
+MANIFEST_SCHEMA_V2 = 2
+SHARD_MANIFEST = "manifest.json"
 
 logger = logging.getLogger(__name__)
 
 
 class CheckpointInvalid(ValueError):
-    """A checkpoint file failed validation (torn zip, CRC mismatch, leaf
-    count/shape mismatch, or config/mesh fingerprint mismatch)."""
+    """A checkpoint failed validation (torn file/dir, CRC mismatch, missing
+    shard files, or config/mesh fingerprint mismatch)."""
 
 
 class CheckpointMismatch(CheckpointInvalid):
-    """The checkpoint is intact but belongs to a DIFFERENT program
-    (config/mesh fingerprint, leaf count, or leaf shapes disagree with the
+    """The checkpoint is intact but belongs to a DIFFERENT program (model
+    identity fingerprint, leaf count, or leaf shapes disagree with the
     restoring run).  Unlike corruption — which is transient per-file bad
     luck worth walking past — a mismatch is deterministic user error:
     ``restore_latest`` raises it rather than silently fresh-starting (and
@@ -67,8 +103,9 @@ class CheckpointMismatch(CheckpointInvalid):
 
 
 # ---------------------------------------------------------------------------
-# Fingerprint: detects "resumed into a different program" before the shape
-# checks would (or, worse, wouldn't — same shapes, different mesh/config).
+# Fingerprints.  The legacy combined fingerprint detects "resumed into a
+# different program"; the split identity/layout pair additionally names
+# WHICH kind of difference, so layout-only skew can restore elastically.
 # ---------------------------------------------------------------------------
 
 # Fields that may legitimately differ between the saving and restoring run:
@@ -77,54 +114,144 @@ class CheckpointMismatch(CheckpointInvalid):
 _FP_EXCLUDE = {"checkpoint_dir", "verbose", "num_workers", "datapath",
                "num_epochs"}
 
+# ParallelConfig fields that describe LAYOUT — where values live and how the
+# step is scheduled — not what the model computes.  A checkpoint may restore
+# across any combination of these (elastic restore) as long as leaf shapes
+# are preserved; everything else is model identity and must match.
+# ``spatial_until``/``split_size`` ARE layout even though changing them
+# re-packs sp_pipeline buffers: the shape check catches the non-elastic
+# cases with a typed error instead of pretending they are identity.
+# ``data_parallel`` is deliberately NOT here: the global batch is
+# batch_size * dp, so a dp change alters the global-step → data mapping —
+# identity, for the same reason steps_per_epoch is.
+LAYOUT_FIELDS = frozenset({
+    "parts", "split_size", "schedule", "num_spatial_parts", "spatial_size",
+    "slice_method", "spatial_until", "quant_collectives", "stripe_bwd",
+    "halo_d2", "fused_layers", "local_dp_lp", "balance",
+    "times", "remat", "pallas_conv", "enable_gems", "enable_master_comm_opt",
+})
+
+
+def _normalize(obj: Any) -> Any:
+    """JSON-able normal form shared by every fingerprint (and by the
+    manifest's human-readable ``layout_desc``)."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return _normalize(dataclasses.asdict(obj))
+    if isinstance(obj, dict):
+        return {
+            str(k): _normalize(v)
+            for k, v in sorted(obj.items(), key=lambda kv: str(kv[0]))
+            if str(k) not in _FP_EXCLUDE
+        }
+    if isinstance(obj, (list, tuple)):
+        return [_normalize(v) for v in obj]
+    if isinstance(obj, (set, frozenset)):
+        # hash randomization makes set iteration order process-dependent
+        return sorted((_normalize(v) for v in obj), key=repr)
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    return repr(obj)
+
 
 def config_fingerprint(*parts: Any) -> str:
     """Stable 16-hex-char digest of config-like objects (dataclasses, dicts,
     tuples, scalars).  Volatile fields (checkpoint dir, verbosity, worker
     count, data path, epoch count) are excluded — they don't change the
     computed state."""
-
-    def norm(obj: Any) -> Any:
-        if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
-            return norm(dataclasses.asdict(obj))
-        if isinstance(obj, dict):
-            return {
-                str(k): norm(v)
-                for k, v in sorted(obj.items(), key=lambda kv: str(kv[0]))
-                if str(k) not in _FP_EXCLUDE
-            }
-        if isinstance(obj, (list, tuple)):
-            return [norm(v) for v in obj]
-        if isinstance(obj, (set, frozenset)):
-            # hash randomization makes set iteration order process-dependent
-            return sorted((norm(v) for v in obj), key=repr)
-        if obj is None or isinstance(obj, (bool, int, float, str)):
-            return obj
-        return repr(obj)
-
-    blob = json.dumps([norm(p) for p in parts], sort_keys=True)
+    blob = json.dumps([_normalize(p) for p in parts], sort_keys=True)
     return hashlib.sha256(blob.encode()).hexdigest()[:16]
 
 
+def split_config_fingerprint(
+    cfg: Any,
+    mesh_spec: Any = None,
+    extra_identity: Optional[dict] = None,
+    extra_layout: Optional[dict] = None,
+) -> Tuple[str, str, dict]:
+    """Split ``cfg`` (a ParallelConfig or dict) into the elastic-restore
+    fingerprint pair; returns ``(identity_fp, layout_fp, layout_desc)``.
+
+    ``identity_fp`` hashes the model-identity fields (must match on
+    restore); ``layout_fp`` hashes :data:`LAYOUT_FIELDS` + the mesh spec +
+    ``extra_layout`` (resolved quant policy, stripe hatch — resolved values,
+    so a hatch override is a layout change, not silent drift).
+    ``layout_desc`` is the normalized layout dict itself, stored in the
+    manifest so reports and drills can SAY what the saved layout was."""
+    d = dataclasses.asdict(cfg) if dataclasses.is_dataclass(cfg) else dict(cfg)
+    ident = {k: v for k, v in d.items()
+             if k not in LAYOUT_FIELDS and k not in _FP_EXCLUDE}
+    layout = {k: v for k, v in d.items() if k in LAYOUT_FIELDS}
+    if mesh_spec is not None:
+        layout["mesh"] = mesh_spec
+    layout.update(extra_layout or {})
+    layout_desc = _normalize(layout)
+    return (
+        config_fingerprint(ident, extra_identity or {}),
+        config_fingerprint(layout_desc),
+        layout_desc,
+    )
+
+
+def _check_fingerprints(
+    manifest: dict,
+    expected: Optional[str],
+    identity: Optional[str],
+    layout: Optional[str],
+    where: str,
+) -> bool:
+    """Fingerprint policy for one manifest; returns ``elastic`` (True when
+    the checkpoint's LAYOUT differs from the restoring run's but the model
+    identity matches).  Raises :class:`CheckpointMismatch` on an identity
+    (or, for legacy single-fingerprint files, any) mismatch.  Unknown sides
+    (None) are permissive — old files and ad-hoc restores still load."""
+    m_ident = manifest.get("identity")
+    m_layout = manifest.get("layout")
+    if identity and m_ident:
+        if m_ident != identity:
+            raise CheckpointMismatch(
+                f"{where}: model identity fingerprint {m_ident} != expected "
+                f"{identity} (checkpoint from a different model/program)"
+            )
+        return bool(layout and m_layout and m_layout != layout)
+    fp = manifest.get("fingerprint")
+    if expected and fp and fp != expected:
+        raise CheckpointMismatch(
+            f"{where}: config/mesh fingerprint {fp} != expected "
+            f"{expected} (checkpoint from a different program)"
+        )
+    return False
+
+
 # ---------------------------------------------------------------------------
-# Save path (two-phase: gather on the training thread, write anywhere)
+# v1 save path (two-phase: gather on the training thread, write anywhere)
 # ---------------------------------------------------------------------------
 
 
 def state_to_arrays(state: Any, step_id: int) -> Dict[str, np.ndarray]:
     """Gather `state` (any pytree of arrays) to host numpy arrays.  This is
     the half that MUST run on the training thread before the next step
-    donates the buffers; the result is safe to hand to a writer thread."""
+    donates the buffers; the result is safe to hand to a writer thread
+    (copies are forced where ``device_get`` returns zero-copy views of
+    donatable buffers — see :func:`_owned_host_copy`).
+    NOTE: this materializes the FULL state on the host — the v2 sharded
+    path (:func:`state_shard_plan`) bounds host memory to one shard."""
     leaves = jax.tree.leaves(state)
-    arrays = {f"leaf_{i}": np.asarray(jax.device_get(l)) for i, l in enumerate(leaves)}
+    arrays = {
+        f"leaf_{i}": _owned_host_copy(jax.device_get(l))
+        for i, l in enumerate(leaves)
+    }
     arrays[STEP_KEY] = np.asarray(step_id, np.int64)
     return arrays
 
 
+def _contig(arr: np.ndarray) -> np.ndarray:
+    # crc32/write read the buffer directly — no .tobytes() copy (GB-scale
+    # stage buffers would transiently double host RSS at the save moment).
+    return np.ascontiguousarray(arr)
+
+
 def _leaf_crc(arr: np.ndarray) -> int:
-    # crc32 reads the buffer directly — no .tobytes() copy (GB-scale stage
-    # buffers would transiently double host RSS at exactly the save moment).
-    return binascii.crc32(np.ascontiguousarray(arr)) & 0xFFFFFFFF
+    return binascii.crc32(_contig(arr)) & 0xFFFFFFFF
 
 
 def _manifest_for(arrays: Dict[str, np.ndarray], fingerprint: Optional[str]) -> dict:
@@ -144,10 +271,18 @@ def _manifest_for(arrays: Dict[str, np.ndarray], fingerprint: Optional[str]) -> 
     }
 
 
+def _fsync_dir(path: str) -> None:
+    dfd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
+
+
 def write_arrays(path: str, arrays: Dict[str, np.ndarray],
                  fingerprint: Optional[str] = None) -> None:
-    """Serialize gathered arrays (+ manifest) to `path`: tmp file, flush,
-    fsync, atomic rename, directory fsync.  Runs on any thread."""
+    """Serialize gathered arrays (+ manifest) to `path` (v1 npz): tmp file,
+    flush, fsync, atomic rename, directory fsync.  Runs on any thread."""
     payload = dict(arrays)
     manifest = _manifest_for(arrays, fingerprint)
     payload[MANIFEST_KEY] = np.frombuffer(
@@ -162,11 +297,7 @@ def write_arrays(path: str, arrays: Dict[str, np.ndarray],
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
-        dfd = os.open(d, os.O_RDONLY)
-        try:
-            os.fsync(dfd)  # make the rename itself durable
-        finally:
-            os.close(dfd)
+        _fsync_dir(d)  # make the rename itself durable
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
@@ -174,8 +305,228 @@ def write_arrays(path: str, arrays: Dict[str, np.ndarray],
 
 def save_state(path: str, state: Any, step_id: int,
                fingerprint: Optional[str] = None) -> None:
-    """Write `state` (any pytree of arrays) to `path` atomically."""
+    """Write `state` (any pytree of arrays) to `path` atomically (v1 npz)."""
     write_arrays(path, state_to_arrays(state, step_id), fingerprint)
+
+
+# ---------------------------------------------------------------------------
+# v2 sharded save path
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class SaveStats:
+    """What one checkpoint save cost — the ``checkpoint`` RunLog record's
+    payload, so checkpoint stalls are observable instead of mystery gaps in
+    the step stream."""
+
+    path: str = ""
+    step_id: int = 0
+    format: str = "sharded"
+    bytes: int = 0
+    shards: int = 0
+    leaves: int = 0
+    gather_ms: float = 0.0
+    write_ms: float = 0.0
+    # Watermark of gathered-but-unwritten host bytes during the save: the
+    # sharded path's memory-bound claim, asserted by tests.
+    peak_pending_bytes: int = 0
+
+    def record(self) -> dict:
+        return {
+            "gstep": self.step_id, "path": self.path, "format": self.format,
+            "bytes": self.bytes, "shards": self.shards, "leaves": self.leaves,
+            "gather_ms": round(self.gather_ms, 3),
+            "write_ms": round(self.write_ms, 3),
+            "peak_pending_bytes": self.peak_pending_bytes,
+        }
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """Resolve a manifest dtype string, including the ml_dtypes extension
+    types (bfloat16, fp8) numpy alone doesn't know."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        try:
+            import ml_dtypes
+
+            return np.dtype(getattr(ml_dtypes, name))
+        except (ImportError, AttributeError) as e:
+            raise CheckpointInvalid(f"unknown leaf dtype {name!r}") from e
+
+
+def _byte_view(arr: np.ndarray) -> np.ndarray:
+    """Flat uint8 view of any contiguous array (works for ml_dtypes custom
+    dtypes whose buffers numpy won't hand out directly)."""
+    a = _contig(arr)
+    if a.ndim == 0:
+        a = a.reshape(1)
+    return a.view(np.uint8).reshape(-1)
+
+
+def _owned_host_copy(x: Any) -> np.ndarray:
+    """Host array that OWNS its bytes.  On CPU backends ``np.asarray`` of a
+    jax array (or of one shard's ``.data``) can be a zero-copy view of the
+    live device buffer; the supervised loop donates that buffer to the next
+    step while the writer thread is still serializing, so a view would be
+    mutated (or freed) mid-write — torn bytes under a valid-looking CRC."""
+    a = np.asarray(x)
+    if a.base is not None or not a.flags.owndata:
+        a = a.copy()
+    return a
+
+
+def state_shard_plan(state: Any) -> List[Tuple[int, dict, List[Tuple[Tuple[int, ...], Callable[[], np.ndarray]]]]]:
+    """Shard-native save plan for ``state``: a list of
+    ``(leaf_id, leaf_meta, [(offset, gather), ...])``.
+
+    Each ``gather()`` returns ONE shard as a host array and must run on the
+    training thread (the next step donates the buffers); everything else can
+    run on a writer thread.  For a sharded ``jax.Array`` the entries are its
+    unique addressable shards keyed by global offset (replicas deduplicated);
+    host/replicated/single-device leaves are one full-array entry."""
+    plan = []
+    for i, leaf in enumerate(jax.tree.leaves(state)):
+        entries: List[Tuple[Tuple[int, ...], Callable[[], np.ndarray]]] = []
+        shape = tuple(getattr(leaf, "shape", np.shape(leaf)))
+        dtype = str(getattr(leaf, "dtype", np.asarray(leaf).dtype))
+        if isinstance(leaf, jax.Array):
+            try:
+                shards = leaf.addressable_shards if leaf.is_fully_addressable else []
+            except Exception:  # noqa: BLE001 — exotic array impls: full gather
+                shards = []
+            seen: Dict[Tuple[int, ...], Any] = {}
+            for sh in shards:
+                off = tuple(int(s.start or 0) for s in sh.index)
+                if off not in seen:
+                    seen[off] = sh
+            if len(seen) > 1:
+                entries = [
+                    (off, (lambda s=sh: _owned_host_copy(s.data)))
+                    for off, sh in sorted(seen.items())
+                ]
+        if not entries:
+            entries = [
+                (tuple(0 for _ in shape),
+                 (lambda l=leaf: _owned_host_copy(jax.device_get(l)))),
+            ]
+        plan.append((i, {"shape": list(shape), "dtype": dtype}, entries))
+    return plan
+
+
+class ShardedSaveTxn:
+    """One in-flight sharded checkpoint write: shard files land fsync'd in a
+    hidden tmp directory; ``commit`` writes the manifest, fsyncs, and
+    publishes with a single atomic directory rename (+ parent fsync) — the
+    same torn-write guarantee as the v1 tmp-file + rename."""
+
+    def __init__(self, path: str, step_id: int,
+                 fingerprint: Optional[str] = None,
+                 identity: Optional[str] = None,
+                 layout: Optional[str] = None,
+                 layout_desc: Optional[dict] = None) -> None:
+        self.path = os.path.abspath(path)
+        self.step_id = int(step_id)
+        self.stats = SaveStats(path=self.path, step_id=self.step_id)
+        self._meta = {"fingerprint": fingerprint, "identity": identity,
+                      "layout": layout, "layout_desc": layout_desc}
+        self._leaves: Dict[int, dict] = {}
+        d = os.path.dirname(self.path)
+        os.makedirs(d, exist_ok=True)
+        self._tmp = tempfile.mkdtemp(dir=d, prefix=f".tmp_ckpt_{step_id}_")
+        self._done = False
+
+    def add_leaf(self, leaf_id: int, meta: dict) -> None:
+        self._leaves[leaf_id] = {"shape": meta["shape"],
+                                 "dtype": meta["dtype"], "shards": []}
+
+    def add_shard(self, leaf_id: int, offset: Tuple[int, ...],
+                  arr: np.ndarray) -> int:
+        """Write one gathered shard durably; returns bytes written.  Any
+        thread."""
+        t0 = time.perf_counter()
+        entry = self._leaves[leaf_id]
+        fname = f"leaf{leaf_id:05d}_s{len(entry['shards']):03d}.bin"
+        view = _byte_view(arr)
+        with open(os.path.join(self._tmp, fname), "wb") as f:
+            f.write(memoryview(view))
+            f.flush()
+            os.fsync(f.fileno())
+        entry["shards"].append({
+            "file": fname,
+            "offset": [int(o) for o in offset],
+            "shape": list(arr.shape),
+            "nbytes": int(view.nbytes),
+            "crc32": binascii.crc32(view) & 0xFFFFFFFF,
+        })
+        self.stats.shards += 1
+        self.stats.bytes += int(view.nbytes)
+        self.stats.write_ms += (time.perf_counter() - t0) * 1e3
+        return int(view.nbytes)
+
+    def commit(self) -> SaveStats:
+        t0 = time.perf_counter()
+        manifest = {
+            "schema": MANIFEST_SCHEMA_V2,
+            "step_id": self.step_id,
+            "leaves": [self._leaves[i] for i in sorted(self._leaves)],
+            **self._meta,
+        }
+        mpath = os.path.join(self._tmp, SHARD_MANIFEST)
+        with open(mpath, "w", encoding="utf-8") as f:
+            json.dump(manifest, f, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        _fsync_dir(self._tmp)
+        aside = None
+        if os.path.isdir(self.path):
+            # Re-save of the same step id (e.g. a boundary re-reached after
+            # rollback).  Directories cannot be atomically replaced the way
+            # v1's os.replace swapped files, so move the old checkpoint
+            # ASIDE by rename first — the crash window between the two
+            # renames can lose the step from the automatic walk (one
+            # checkpoint interval, same as a torn save) but never deletes
+            # the old data before the new version is fully published.
+            aside = tempfile.mkdtemp(
+                dir=os.path.dirname(self.path),
+                prefix=f".old_ckpt_{self.step_id}_",
+            )
+            os.rmdir(aside)  # need the unique NAME; rename creates the dir
+            os.replace(self.path, aside)
+        os.replace(self._tmp, self.path)
+        _fsync_dir(os.path.dirname(self.path))
+        if aside is not None:
+            shutil.rmtree(aside, ignore_errors=True)
+        self._done = True
+        self.stats.leaves = len(self._leaves)
+        self.stats.write_ms += (time.perf_counter() - t0) * 1e3
+        return self.stats
+
+    def abort(self) -> None:
+        if not self._done:
+            shutil.rmtree(self._tmp, ignore_errors=True)
+            self._done = True
+
+
+def _stream_state_into(txn: "ShardedSaveTxn", state: Any) -> None:
+    """Gather → write → free, one shard at a time (peak host bytes = the
+    largest shard, by construction); aborts the transaction on any error."""
+    try:
+        for leaf_id, meta, entries in state_shard_plan(state):
+            txn.add_leaf(leaf_id, meta)
+            for offset, gather in entries:
+                t0 = time.perf_counter()
+                arr = gather()
+                txn.stats.gather_ms += (time.perf_counter() - t0) * 1e3
+                txn.stats.peak_pending_bytes = max(
+                    txn.stats.peak_pending_bytes, int(arr.nbytes)
+                )
+                txn.add_shard(leaf_id, offset, arr)
+                del arr
+    except BaseException:
+        txn.abort()
+        raise
 
 
 # ---------------------------------------------------------------------------
@@ -183,29 +534,193 @@ def save_state(path: str, state: Any, step_id: int,
 # ---------------------------------------------------------------------------
 
 
+def checkpoint_format(path: str) -> str:
+    """``"sharded"`` (v2 directory) or ``"npz"`` (v1 file)."""
+    return "sharded" if os.path.isdir(path) else "npz"
+
+
+def read_sharded_manifest(path: str) -> dict:
+    mpath = os.path.join(path, SHARD_MANIFEST)
+    try:
+        with open(mpath, "r", encoding="utf-8") as f:
+            return json.load(f)
+    except OSError as e:
+        raise CheckpointInvalid(f"{path}: no readable manifest ({e!r})") from e
+    except ValueError as e:
+        raise CheckpointInvalid(f"{path}: bad manifest ({e!r})") from e
+
+
+def _peek_npz_manifest(path: str) -> Tuple[Optional[dict], Any]:
+    """Open a v1 npz and read ONLY the manifest member (the zip central
+    directory read catches truncation; the member's own zip CRC catches a
+    corrupted manifest) — no leaf bytes touched."""
+    try:
+        z = np.load(path)
+    except Exception as e:  # zipfile/np errors on torn files vary
+        raise CheckpointInvalid(f"{path}: unreadable ({e!r})") from e
+    if MANIFEST_KEY not in z.files:
+        return None, z
+    try:
+        manifest = json.loads(bytes(z[MANIFEST_KEY]).decode())
+    except Exception as e:  # noqa: BLE001 — zlib/json/unicode all mean torn
+        z.close()
+        raise CheckpointInvalid(f"{path}: bad manifest ({e!r})") from e
+    return manifest, z
+
+
+def _manifest_leaf_shapes(manifest: dict) -> Optional[List[Tuple[int, ...]]]:
+    leaves = manifest.get("leaves")
+    if leaves is None:
+        return None
+    if isinstance(leaves, dict):  # v1: {"leaf_3": {...}}
+        try:
+            items = sorted(leaves.items(), key=lambda kv: int(kv[0][5:]))
+        except ValueError:
+            return None
+        return [tuple(v.get("shape", ())) for _, v in items]
+    return [tuple(l.get("shape", ())) for l in leaves]  # v2: ordered list
+
+
+def cheap_validate(path: str, template: Any = None,
+                   fingerprint: Optional[str] = None,
+                   identity: Optional[str] = None,
+                   layout: Optional[str] = None) -> Tuple[Optional[dict], bool]:
+    """Manifest-first validation pass: costs KBs, reads no array bytes.
+
+    Checks: the container is openable (zip central directory / manifest
+    JSON), fingerprints (identity hard, layout soft), leaf count + shapes
+    against ``template``, and — for sharded checkpoints — that every shard
+    file exists with exactly its manifest size (a vanished or truncated
+    shard fails HERE, before any assembly).  Returns ``(manifest,
+    elastic)``; per-shard CRC verification happens at full load."""
+    fmt = checkpoint_format(path)
+    if fmt == "sharded":
+        manifest = read_sharded_manifest(path)
+        if manifest.get("schema") != MANIFEST_SCHEMA_V2:
+            raise CheckpointInvalid(
+                f"{path}: unknown sharded schema {manifest.get('schema')!r}"
+            )
+        for leaf_id, leaf in enumerate(manifest.get("leaves", [])):
+            total = 0
+            for sh in leaf.get("shards", []):
+                fpath = os.path.join(path, sh["file"])
+                try:
+                    size = os.stat(fpath).st_size
+                except OSError as e:
+                    raise CheckpointInvalid(
+                        f"{path}: shard file {sh['file']} missing "
+                        f"(leaf {leaf_id}): {e!r}"
+                    ) from e
+                if size != sh["nbytes"]:
+                    raise CheckpointInvalid(
+                        f"{path}: shard file {sh['file']} is {size} bytes, "
+                        f"manifest says {sh['nbytes']} (torn write?)"
+                    )
+                total += sh["nbytes"]
+            expect = int(np.prod(leaf["shape"], dtype=np.int64)
+                         ) * _np_dtype(leaf["dtype"]).itemsize
+            if total != expect:
+                raise CheckpointInvalid(
+                    f"{path}: leaf {leaf_id} shards cover {total} bytes of "
+                    f"{expect} (incomplete shard set)"
+                )
+    else:
+        manifest, z = _peek_npz_manifest(path)
+        z.close()
+        if manifest is None:
+            return None, False  # ancient file: nothing to validate cheaply
+    elastic = _check_fingerprints(manifest, fingerprint, identity, layout, path)
+    if template is not None:
+        shapes = _manifest_leaf_shapes(manifest)
+        if shapes is not None:
+            tmpl_shapes = [
+                tuple(getattr(l, "shape", np.shape(l)))
+                for l in jax.tree.leaves(template)
+            ]
+            if len(shapes) != len(tmpl_shapes):
+                raise CheckpointMismatch(
+                    f"{path}: checkpoint has {len(shapes)} leaves, state "
+                    f"needs {len(tmpl_shapes)}"
+                )
+            for i, (a, b) in enumerate(zip(shapes, tmpl_shapes)):
+                if tuple(a) != tuple(b):
+                    raise CheckpointMismatch(
+                        f"{path}: leaf {i}: checkpoint shape {tuple(a)} != "
+                        f"state {b}"
+                        + (" (layout change is not leaf-shape-preserving — "
+                           "this geometry cannot restore elastically)"
+                           if elastic else "")
+                    )
+    return manifest, elastic
+
+
+def _read_shard_bytes(path: str) -> bytes:
+    """Read one shard file fully (indirection point: tests count calls to
+    prove the cheap-validation pass reads no array bytes)."""
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def load_sharded_arrays(path: str, manifest: Optional[dict] = None
+                        ) -> Tuple[Dict[str, np.ndarray], int]:
+    """Full load of a v2 checkpoint: every leaf reassembled from its shards
+    at their global offsets, each shard CRC32-verified.  Returns the same
+    ``{"leaf_<i>": array}`` dict shape as the v1 loader."""
+    manifest = manifest if manifest is not None else read_sharded_manifest(path)
+    arrays: Dict[str, np.ndarray] = {}
+    for leaf_id, leaf in enumerate(manifest.get("leaves", [])):
+        dtype = _np_dtype(leaf["dtype"])
+        shape = tuple(leaf["shape"])
+        out = np.empty(shape, dtype)
+        for sh in leaf["shards"]:
+            try:
+                raw = _read_shard_bytes(os.path.join(path, sh["file"]))
+            except OSError as e:  # vanished/unreadable shard = torn ckpt
+                raise CheckpointInvalid(
+                    f"{path}: shard file {sh['file']} unreadable ({e!r})"
+                ) from e
+            if (binascii.crc32(raw) & 0xFFFFFFFF) != sh["crc32"]:
+                raise CheckpointInvalid(
+                    f"{path}: CRC32 mismatch on {sh['file']} (leaf {leaf_id})"
+                )
+            if len(raw) != sh["nbytes"]:
+                raise CheckpointInvalid(
+                    f"{path}: {sh['file']} is {len(raw)} bytes, manifest "
+                    f"says {sh['nbytes']}"
+                )
+            block = np.frombuffer(raw, dtype).reshape(sh["shape"])
+            if not shape:
+                out = block.reshape(())
+            else:
+                sl = tuple(
+                    slice(o, o + n) for o, n in zip(sh["offset"], sh["shape"])
+                )
+                out[sl] = block
+        arrays[f"leaf_{leaf_id}"] = out
+    return arrays, int(manifest.get("step_id", 0))
+
+
 def load_arrays(path: str, expected_fingerprint: Optional[str] = None
                 ) -> Tuple[Dict[str, np.ndarray], int]:
-    """Load and VALIDATE one checkpoint file; returns (arrays, step_id).
+    """Load and VALIDATE one checkpoint (either format); returns
+    ``(arrays, step_id)``.
 
-    Raises :class:`CheckpointInvalid` on a torn/corrupt file, a per-leaf
-    CRC mismatch, or a fingerprint mismatch (both sides non-null)."""
+    Raises :class:`CheckpointInvalid` on a torn/corrupt file, a per-leaf or
+    per-shard CRC mismatch, or a fingerprint mismatch (both sides
+    non-null)."""
+    if checkpoint_format(path) == "sharded":
+        manifest = read_sharded_manifest(path)
+        _check_fingerprints(manifest, expected_fingerprint, None, None, path)
+        return load_sharded_arrays(path, manifest)
+    manifest, z = _peek_npz_manifest(path)
     try:
-        with np.load(path) as z:
-            arrays = {k: z[k] for k in z.files}
-    except Exception as e:  # zipfile/np errors on torn files vary by corruption
+        arrays = {k: z[k] for k in z.files if k != MANIFEST_KEY}
+    except Exception as e:  # torn member payloads surface here
         raise CheckpointInvalid(f"{path}: unreadable ({e!r})") from e
-    manifest = None
-    if MANIFEST_KEY in arrays:
-        try:
-            manifest = json.loads(bytes(arrays.pop(MANIFEST_KEY)).decode())
-        except (ValueError, UnicodeDecodeError) as e:
-            raise CheckpointInvalid(f"{path}: bad manifest ({e!r})") from e
-        fp = manifest.get("fingerprint")
-        if expected_fingerprint and fp and fp != expected_fingerprint:
-            raise CheckpointMismatch(
-                f"{path}: config/mesh fingerprint {fp} != expected "
-                f"{expected_fingerprint} (checkpoint from a different program)"
-            )
+    finally:
+        z.close()
+    if manifest is not None:
+        _check_fingerprints(manifest, expected_fingerprint, None, None, path)
         for k, info in manifest.get("leaves", {}).items():
             a = arrays.get(k)
             if a is None:
@@ -221,7 +736,10 @@ def load_arrays(path: str, expected_fingerprint: Optional[str] = None
 
 def arrays_to_state(arrays: Dict[str, np.ndarray], template: Any) -> Any:
     """Map loaded leaf arrays into the structure (and shardings) of
-    `template`.  Shapes/dtypes are checked leaf-by-leaf."""
+    `template`.  Shapes/dtypes are checked leaf-by-leaf.  This is also the
+    elastic-restore workhorse: the reassembled full leaf is ``device_put``
+    under the TEMPLATE's sharding, whatever mesh that template was built
+    on."""
     leaves, treedef = jax.tree.flatten(template)
     n = sum(1 for k in arrays if k.startswith("leaf_"))
     if n != len(leaves):
@@ -259,22 +777,72 @@ def restore_state(path: str, template: Any,
     return arrays_to_state(arrays, template)
 
 
+@dataclasses.dataclass
+class RestoreInfo:
+    """What ``restore_latest`` actually did — surfaced so callers (and the
+    drill harness) can distinguish a same-layout restore from an elastic
+    one, and can SAY which layout the checkpoint was saved under."""
+
+    path: str
+    step_id: int
+    format: str
+    elastic: bool = False
+    saved_layout: Optional[dict] = None
+
+    def record(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# ---------------------------------------------------------------------------
+# Manager
+# ---------------------------------------------------------------------------
+
+
 class CheckpointManager:
-    """Numbered checkpoints in a directory: ckpt_<step>.npz, keep the newest
-    ``keep`` files.  ``fingerprint`` (from :func:`config_fingerprint`) is
-    stamped into every manifest and enforced on restore."""
+    """Numbered checkpoints in a directory — ``ckpt_<step>/`` sharded dirs
+    (format="sharded", the default) or ``ckpt_<step>.npz`` v1 files
+    (format="npz") — keeping the newest ``keep``.  ``restore_latest`` reads
+    BOTH formats regardless of the write format.
+
+    Fingerprints: ``fingerprint`` is the legacy combined digest (stamped for
+    old readers, enforced on files that carry nothing newer);
+    ``identity``/``layout`` are the split pair from
+    :func:`split_config_fingerprint` — identity must match, layout skew
+    triggers elastic restore.  ``layout_desc`` (the normalized layout dict)
+    is stored in every manifest for reporting."""
 
     def __init__(self, directory: str, keep: int = 3,
-                 fingerprint: Optional[str] = None) -> None:
+                 fingerprint: Optional[str] = None, *,
+                 identity: Optional[str] = None,
+                 layout: Optional[str] = None,
+                 layout_desc: Optional[dict] = None,
+                 format: str = "sharded") -> None:
+        assert format in ("sharded", "npz"), format
         self.directory = directory
         self.keep = keep
         self.fingerprint = fingerprint
+        self.identity = identity
+        self.layout = layout
+        self.layout_desc = layout_desc
+        self.format = format
+        self.last_save_stats: Optional[SaveStats] = None
+        self.last_restore: Optional[RestoreInfo] = None
         os.makedirs(directory, exist_ok=True)
+        # A hard crash can strand hidden work dirs (.tmp_ckpt_* from a save
+        # killed mid-write, .old_ckpt_* from a re-save killed mid-swap) —
+        # full checkpoint-sized garbage nothing else reclaims.  Managers are
+        # never constructed concurrently with another manager's in-flight
+        # save on the same directory (prune would race it anyway), so init
+        # is a safe reclamation point.
+        for fn in os.listdir(directory):
+            if fn.startswith((".tmp_ckpt_", ".old_ckpt_")):
+                shutil.rmtree(os.path.join(directory, fn),
+                              ignore_errors=True)
 
     def _all(self):
         out = []
         for fn in os.listdir(self.directory):
-            m = _CKPT_RE.match(fn)
+            m = _CKPT_RE.match(fn) or _CKPT_DIR_RE.match(fn)
             if m:
                 out.append((int(m.group(1)), os.path.join(self.directory, fn)))
         return sorted(out)
@@ -284,49 +852,144 @@ class CheckpointManager:
         return all_[-1][1] if all_ else None
 
     def path_for(self, step_id: int) -> str:
-        return os.path.join(self.directory, f"ckpt_{step_id}.npz")
+        name = f"ckpt_{step_id}" + (".npz" if self.format == "npz" else "")
+        return os.path.join(self.directory, name)
+
+    def _prune(self) -> None:
+        for _sid, p in self._all()[: -self.keep]:
+            if os.path.isdir(p):
+                shutil.rmtree(p)
+            else:
+                os.unlink(p)
+
+    def begin_save(self, step_id: int) -> ShardedSaveTxn:
+        """Open a sharded-save transaction at this step's final path (the
+        async writer drives it shard-by-shard; ``finish_save`` completes)."""
+        return ShardedSaveTxn(
+            self.path_for(step_id), step_id, self.fingerprint,
+            self.identity, self.layout, self.layout_desc,
+        )
+
+    def finish_save(self, txn: ShardedSaveTxn) -> SaveStats:
+        try:
+            stats = txn.commit()
+        except BaseException:
+            # Disk-full / rename failure mid-commit: never leave the hidden
+            # tmp directory (a full checkpoint-sized state copy) behind.
+            txn.abort()
+            raise
+        self.last_save_stats = stats
+        self._prune()
+        return stats
 
     def save_arrays(self, arrays: Dict[str, np.ndarray], step_id: int) -> str:
-        """Write pre-gathered arrays (the writer-thread half of save)."""
+        """Write pre-gathered FULL arrays (the v1 writer-thread half of
+        save).  Under format="sharded" each leaf lands as a single shard —
+        API-compatible, but without the shard-native memory bound."""
         path = self.path_for(step_id)
-        write_arrays(path, arrays, self.fingerprint)
-        for _sid, p in self._all()[: -self.keep]:
-            os.unlink(p)
+        if self.format == "npz":
+            write_arrays(path, arrays, self.fingerprint)
+            self.last_save_stats = SaveStats(
+                path=path, step_id=step_id, format="npz",
+                bytes=sum(int(a.nbytes) for a in arrays.values()),
+                leaves=sum(1 for k in arrays if k.startswith("leaf_")),
+            )
+        else:
+            txn = self.begin_save(step_id)
+            try:
+                for k in sorted(
+                    (k for k in arrays if k.startswith("leaf_")),
+                    key=lambda k: int(k[5:]),
+                ):
+                    a = np.asarray(arrays[k])
+                    leaf_id = int(k[5:])
+                    txn.add_leaf(leaf_id, {"shape": list(a.shape),
+                                           "dtype": str(a.dtype)})
+                    txn.add_shard(leaf_id, tuple(0 for _ in a.shape), a)
+            except BaseException:
+                txn.abort()
+                raise
+            self.finish_save(txn)
+            return path
+        self._prune()
         return path
 
     def save(self, state: Any, step_id: int) -> str:
-        return self.save_arrays(state_to_arrays(state, step_id), step_id)
+        """Save ``state`` in this manager's format; under "sharded" the
+        gathers run shard-by-shard (peak host = one shard)."""
+        if self.format == "npz":
+            return self.save_arrays(state_to_arrays(state, step_id), step_id)
+        txn = self.begin_save(step_id)
+        _stream_state_into(txn, state)
+        self.finish_save(txn)
+        return txn.path
 
     def restore_latest(self, template: Any,
                        require: bool = False) -> Tuple[Any, int]:
         """Restore the newest VALID checkpoint; returns ``(state, step_id)``.
 
-        Torn, corrupt, or fingerprint-mismatched files are skipped (with a
-        warning) in favor of the next-older one — a preemption mid-write or
-        a bad disk costs one checkpoint interval, not the run.  With no
-        valid checkpoint at all: returns ``(template, 0)`` — a fresh start
-        — unless ``require=True``, which raises :class:`CheckpointInvalid`
-        instead (for callers like anomaly rollback, where ``template`` is a
-        corrupted live state that must NOT be silently handed back).
+        The walk is manifest-first: every candidate is cheaply validated
+        (fingerprints, leaf shapes vs the template, shard-file sizes — no
+        array bytes) and only the first survivor pays a full read + CRC
+        pass; if THAT fails, the walk continues.  Torn or corrupt files are
+        skipped with a warning — a preemption mid-write or a bad disk costs
+        one checkpoint interval, not the run.  A checkpoint whose LAYOUT
+        fingerprint differs but whose identity matches restores
+        elastically: leaves are reassembled from their global offsets and
+        ``device_put`` under the template's (target-mesh) shardings;
+        ``self.last_restore.elastic`` records that it happened.
+
+        With no valid checkpoint at all: returns ``(template, 0)`` — a
+        fresh start — unless ``require=True``, which raises
+        :class:`CheckpointInvalid` instead (for callers like anomaly
+        rollback, where ``template`` is a corrupted live state that must
+        NOT be silently handed back).
 
         Exception: when every file is invalid and at least one failed with
-        :class:`CheckpointMismatch` (wrong fingerprint/leaves — a different
+        :class:`CheckpointMismatch` (wrong identity/leaves — a different
         program, deterministic user error), that mismatch is raised even
         with ``require=False``: silently fresh-starting would then let the
         new run's saves prune away the mismatched run's checkpoints."""
         mismatch: Optional[CheckpointMismatch] = None
         for _sid, path in reversed(self._all()):
             try:
-                arrays, step_id = load_arrays(path, self.fingerprint)
+                manifest, elastic = cheap_validate(
+                    path, template, self.fingerprint, self.identity,
+                    self.layout,
+                )
+            except CheckpointMismatch as e:
+                logger.warning("checkpoint from a different program %s: %s",
+                               path, e)
+                mismatch = mismatch or e
+                continue
+            except Exception as e:  # noqa: BLE001 — torn/corrupt: walk past
+                logger.warning("skipping invalid checkpoint %s: %s", path, e)
+                continue
+            try:
+                if checkpoint_format(path) == "sharded":
+                    arrays, step_id = load_sharded_arrays(path, manifest)
+                else:
+                    arrays, step_id = load_arrays(path, self.fingerprint)
                 state = arrays_to_state(arrays, template)
             except CheckpointMismatch as e:
                 logger.warning("checkpoint from a different program %s: %s",
                                path, e)
                 mismatch = mismatch or e
                 continue
-            except Exception as e:
+            except Exception as e:  # noqa: BLE001 — torn/corrupt: walk past
                 logger.warning("skipping invalid checkpoint %s: %s", path, e)
                 continue
+            self.last_restore = RestoreInfo(
+                path=path, step_id=step_id, format=checkpoint_format(path),
+                elastic=elastic,
+                saved_layout=(manifest or {}).get("layout_desc"),
+            )
+            if elastic:
+                logger.warning(
+                    "ELASTIC restore from %s (step %d): checkpoint layout "
+                    "differs from this run's; leaves re-placed under the "
+                    "target mesh shardings", path, step_id,
+                )
             logger.info("restored checkpoint %s (step %d)", path, step_id)
             return state, step_id
         if mismatch is not None:

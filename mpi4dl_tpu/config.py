@@ -107,8 +107,15 @@ HATCHES: Dict[str, Hatch] = {
         Hatch("MPI4DL_FAULT", "<unset>",
               "Deterministic fault injection: `<kind>@<step>[:arg]` with "
               "kind in nan_loss|nan_batch|raise|sigterm|corrupt_ckpt|"
-              "stall_data — drives tests/test_resilience.py and the CI "
-              "kill-and-resume job (docs/resilience.md)."),
+              "lost_shard_files|reshape|stall_data — drives "
+              "tests/test_resilience.py and the CI kill-and-resume + "
+              "resilience-drill jobs (docs/resilience.md)."),
+        Hatch("MPI4DL_CKPT_HOST_BYTES", str(1 << 30),
+              "Byte budget for gathered-but-unwritten checkpoint shards in "
+              "the async writer (sharded format): the training thread "
+              "blocks instead of materializing more than this on the host, "
+              "so peak save RSS is O(budget + largest shard), not O(full "
+              "state) (docs/resilience.md)."),
         Hatch("MPI4DL_WATCHDOG_SECS", "0",
               "Step watchdog wall-clock budget in seconds (0 = off): a step "
               "(batch fetch + device step) exceeding it dumps live Python "

@@ -107,6 +107,38 @@ def render_run(path: str) -> str:
             parts.append(f"{r['kind']}@{at}{extra}")
         lines.append("resilience events: " + "; ".join(parts))
 
+    # -- checkpoint ledger (ISSUE 13: save cost + elastic restores) --------
+    ckpts = [r for r in records if r.get("kind") == "checkpoint"]
+    if ckpts:
+        total_b = sum(int(r.get("bytes") or 0) for r in ckpts)
+        gather = sum(float(r.get("gather_ms") or 0) for r in ckpts)
+        write = sum(float(r.get("write_ms") or 0) for r in ckpts)
+        peak = max(int(r.get("peak_pending_bytes") or 0) for r in ckpts)
+        lines.append(
+            f"checkpoints: {len(ckpts)} saves  {_fmt_bytes(total_b)}  "
+            f"gather {gather:.1f} ms  write {write:.1f} ms  "
+            f"peak pending {_fmt_bytes(peak)}"
+        )
+    restores = [r for r in records if r.get("kind") == "restore"]
+    for r in restores:
+        lines.append(
+            f"restore: step {r.get('step_id')} from {r.get('path')}"
+            + (" [ELASTIC — saved under a different layout]"
+               if r.get("elastic") else "")
+        )
+
+    # -- drill verdicts (python -m mpi4dl_tpu.resilience drill) ------------
+    drills = [r for r in records if r.get("kind") == "drill"]
+    if drills:
+        ok = sum(1 for r in drills if r.get("passed"))
+        lines.append(f"drills: {ok}/{len(drills)} verified recoveries")
+        for r in drills:
+            mark = "PASS" if r.get("passed") else "FAIL"
+            extra = "" if r.get("passed") else f" — {r.get('reason', '')}"
+            lines.append(
+                f"  {mark} {r.get('scenario')}: {r.get('verdict')}{extra}"
+            )
+
     # -- memory watermark --------------------------------------------------
     dev_peaks = [r.get("memory_peak_bytes") for r in steps
                  if r.get("memory_peak_bytes") is not None]

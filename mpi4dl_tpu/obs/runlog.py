@@ -3,7 +3,8 @@
 One run = one ``.jsonl`` file; one line = one record, every record carrying
 ``kind`` (meta | cost | step | summary | hbm | timeline | overlap |
 mem_probe | junction_sweep | xprof_ops | readiness | anomaly | recovery |
-preempt | <custom> — field reference in docs/observability.md), ``t`` (unix
+preempt | checkpoint | restore | drill | drill_summary | <custom> — field
+reference in docs/observability.md), ``t`` (unix
 seconds) and ``schema``.  The first record is the run's metadata — full config, mesh spec,
 device kind, jax version, active ``MPI4DL_*`` hatches — so a step file is
 self-describing: no PERF_NOTES archaeology to learn what produced it
@@ -23,6 +24,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -110,6 +112,13 @@ class RunLog:
         # Most recent record written (any kind) — the step watchdog dumps it
         # to stderr alongside live stacks when a step blows its budget.
         self.last_record: Optional[Dict[str, Any]] = None
+        # Most recent record PER KIND: the watchdog pairs the last record
+        # with the last `checkpoint` record so a stall inside a shard-
+        # gather is distinguishable from a data stall.
+        self.last_by_kind: Dict[str, Dict[str, Any]] = {}
+        # The async checkpoint writer emits `checkpoint` records from its
+        # worker thread while the training thread writes `step` records.
+        self._lock = threading.Lock()
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         self._fh = open(path, "a", encoding="utf-8")
 
@@ -131,9 +140,11 @@ class RunLog:
     def write(self, kind: str, **fields: Any) -> Dict[str, Any]:
         rec = {"kind": kind, "schema": SCHEMA_VERSION, "t": time.time()}
         rec.update({k: _jsonable(v) for k, v in fields.items()})
-        self._fh.write(json.dumps(rec) + "\n")
-        self._fh.flush()
-        self.last_record = rec
+        with self._lock:
+            self._fh.write(json.dumps(rec) + "\n")
+            self._fh.flush()
+            self.last_record = rec
+            self.last_by_kind[kind] = rec
         return rec
 
     def write_meta(self, config: Any = None, mesh_spec: Any = None,

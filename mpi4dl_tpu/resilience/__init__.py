@@ -17,9 +17,14 @@ a multi-day pathology run.  This package turns the existing pieces
   (device_get on the training thread, serialize+fsync off it).
 - :mod:`~mpi4dl_tpu.resilience.faults` — deterministic fault injection via
   ``MPI4DL_FAULT=<kind>@<step>[:arg]`` — powers tests and the CI
-  kill-and-resume job.
+  kill-and-resume job; ISSUE 13 adds the mesh-level kinds
+  (``lost_shard_files``, ``reshape``).
 - :mod:`~mpi4dl_tpu.resilience.watchdog` — step wall-clock watchdog that
-  dumps live stacks + the last RunLog record before a hang dies silently.
+  dumps live stacks, the last RunLog + ``checkpoint`` records, and live
+  memory stats before a hang dies silently.
+- :mod:`~mpi4dl_tpu.resilience.drill` — the mesh-fault drill harness
+  (``python -m mpi4dl_tpu.resilience drill``): scripted disasters with
+  typed per-scenario verdicts.
 
 Event schema, fault kinds, manifest format, recovery semantics:
 docs/resilience.md.
@@ -27,13 +32,22 @@ docs/resilience.md.
 
 from __future__ import annotations
 
+from mpi4dl_tpu.resilience.drill import (
+    DrillVerdict,
+    Scenario,
+    default_scenarios,
+    run_drills,
+    run_scenario,
+)
 from mpi4dl_tpu.resilience.faults import (
+    CKPT_FAULT_KINDS,
     FAULT_KINDS,
     FaultInjected,
     FaultInjector,
     FaultSpec,
     corrupt_file,
     fault_from_env,
+    lose_shard_files,
     parse_fault,
 )
 from mpi4dl_tpu.resilience.guard import AnomalyError, AnomalyGuard, global_norm
@@ -47,22 +61,29 @@ from mpi4dl_tpu.resilience.watchdog import (
 from mpi4dl_tpu.resilience.writer import AsyncCheckpointWriter, CheckpointWriteError
 
 __all__ = [
+    "CKPT_FAULT_KINDS",
     "FAULT_KINDS",
     "AnomalyError",
     "AnomalyGuard",
     "AsyncCheckpointWriter",
     "CheckpointWriteError",
+    "DrillVerdict",
     "FaultInjected",
     "FaultInjector",
     "FaultSpec",
     "LoopResult",
     "PreemptionHandler",
+    "Scenario",
     "StepWatchdog",
     "corrupt_file",
+    "default_scenarios",
     "dump_stacks",
     "fault_from_env",
     "global_norm",
+    "lose_shard_files",
     "parse_fault",
+    "run_drills",
+    "run_scenario",
     "run_supervised",
     "watchdog_budget_from_env",
 ]

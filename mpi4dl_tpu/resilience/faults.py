@@ -1,24 +1,37 @@
-"""Deterministic fault injection (ISSUE 3 component 3).
+"""Deterministic fault injection (ISSUE 3 component 3; mesh-level kinds in
+ISSUE 13).
 
 One hatch drives everything: ``MPI4DL_FAULT=<kind>@<step>[:arg]`` (declared
 in ``config.HATCHES``).  The supervised loop calls the injector at fixed,
 documented points, so a fault fires at exactly one global step and the same
-spec reproduces the same failure in pytest, in the CI kill-and-resume job,
-and in a by-hand run.  Kinds:
+spec reproduces the same failure in pytest, in the CI drill jobs, and in a
+by-hand run.  Kinds:
 
-=================  ==========================================================
-``nan_loss``       replace the observed loss at step k with NaN (guard path
-                   without touching device state)
-``nan_batch``      poison the input batch at step k with NaN (device state
-                   genuinely corrupts — the full rollback path)
-``raise``          raise :class:`FaultInjected` before step k (crash path)
-``sigterm``        deliver SIGTERM to this process before step k (preemption
-                   path: finish the step, checkpoint, exit 0)
-``corrupt_ckpt``   flip bytes mid-file in the first checkpoint written at or
-                   after step k (restore must fall back to an older file)
-``stall_data``     the data producer sleeps ``arg`` seconds (default 2.0)
-                   before batch k (watchdog path)
-=================  ==========================================================
+===================  ========================================================
+``nan_loss``         replace the observed loss at step k with NaN (guard
+                     path without touching device state)
+``nan_batch``        poison the input batch at step k with NaN (device state
+                     genuinely corrupts — the full rollback path)
+``raise``            raise :class:`FaultInjected` before step k (crash path)
+``sigterm``          deliver SIGTERM to this process before step k
+                     (preemption path: finish the step, checkpoint, exit 0)
+``corrupt_ckpt``     flip bytes mid-file in the first checkpoint written at
+                     or after step k (restore must fall back to an older
+                     file); on a sharded checkpoint the largest shard file
+                     is corrupted
+``lost_shard_files`` a host's shard files vanish: delete alternate shard
+                     files from the first checkpoint written at or after
+                     step k (cheap validation must reject it and restore
+                     must fall back)
+``reshape``          deliver SIGTERM before step k like ``sigterm``, but
+                     declare that the RESUME must run under a different
+                     geometry — ``arg`` is a free-form spec (e.g.
+                     ``slice-method=horizontal,parts=2``) the drill runner
+                     applies to the resume leg's flags; the loop itself
+                     treats it as a preemption
+``stall_data``       the data producer sleeps ``arg`` seconds (default 2.0)
+                     before batch k (watchdog path)
+===================  ========================================================
 
 Every injector fires at most once per process — deterministic single-shot
 semantics, so "exactly one rollback" is a meaningful assertion.
@@ -32,8 +45,12 @@ import signal
 from typing import Any, Optional
 
 FAULT_KINDS = (
-    "nan_loss", "nan_batch", "raise", "sigterm", "corrupt_ckpt", "stall_data",
+    "nan_loss", "nan_batch", "raise", "sigterm", "corrupt_ckpt",
+    "lost_shard_files", "reshape", "stall_data",
 )
+
+# Kinds whose effect is applied to the just-written checkpoint (after_save).
+CKPT_FAULT_KINDS = ("corrupt_ckpt", "lost_shard_files")
 
 
 class FaultInjected(RuntimeError):
@@ -45,10 +62,13 @@ class FaultSpec:
     kind: str
     step: int
     arg: float = 0.0
+    opts: str = ""  # non-numeric arg text (the reshape geometry spec)
 
 
 def parse_fault(text: Optional[str]) -> Optional[FaultSpec]:
-    """Parse ``<kind>@<step>[:arg]``; empty/None means no fault."""
+    """Parse ``<kind>@<step>[:arg]``; empty/None means no fault.  A numeric
+    ``arg`` lands in ``FaultSpec.arg``; anything else (the reshape spec) in
+    ``FaultSpec.opts``."""
     if not text:
         return None
     head, _, arg = text.partition(":")
@@ -58,16 +78,44 @@ def parse_fault(text: Optional[str]) -> Optional[FaultSpec]:
             f"MPI4DL_FAULT={text!r}: expected <kind>@<step>[:arg] with kind "
             f"in {FAULT_KINDS}"
         )
-    return FaultSpec(kind, int(step), float(arg) if arg else 0.0)
+    num, opts = 0.0, ""
+    if arg:
+        if kind == "reshape":  # the only kind with a free-text arg
+            opts = arg
+        else:
+            try:
+                num = float(arg)
+            except ValueError:
+                raise ValueError(
+                    f"MPI4DL_FAULT={text!r}: {kind} takes a numeric arg, "
+                    f"got {arg!r}"
+                ) from None
+    return FaultSpec(kind, int(step), num, opts)
 
 
 def fault_from_env() -> Optional[FaultSpec]:
     return parse_fault(os.environ.get("MPI4DL_FAULT", ""))
 
 
+def _dir_shard_files(path: str):
+    """Shard payload files of a sharded checkpoint dir, largest first."""
+    out = []
+    for fn in os.listdir(path):
+        if fn.endswith(".bin"):
+            p = os.path.join(path, fn)
+            out.append((os.path.getsize(p), p))
+    return [p for _sz, p in sorted(out, reverse=True)]
+
+
 def corrupt_file(path: str, nbytes: int = 64) -> None:
     """Flip ``nbytes`` in the middle of ``path`` — simulates on-disk
-    corruption the zip layer may not even notice (the manifest CRC does)."""
+    corruption the container layer may not even notice (the manifest CRC
+    does).  On a sharded checkpoint DIRECTORY the largest shard file is
+    corrupted (its size is unchanged, so only the CRC pass can tell)."""
+    if os.path.isdir(path):
+        shards = _dir_shard_files(path)
+        assert shards, f"{path}: no shard files to corrupt"
+        path = shards[0]
     size = os.path.getsize(path)
     off = size // 2
     with open(path, "r+b") as f:
@@ -77,6 +125,21 @@ def corrupt_file(path: str, nbytes: int = 64) -> None:
         f.write(bytes((~b) & 0xFF for b in chunk))
         f.flush()
         os.fsync(f.fileno())
+
+
+def lose_shard_files(path: str) -> None:
+    """Make a host's shard files vanish: delete alternate shard files (at
+    least one) from a sharded checkpoint dir, manifest left intact — the
+    manifest-first cheap validation must reject the checkpoint on a stat
+    pass.  On a v1 file the whole checkpoint vanishes (one file IS the
+    host's shard set there)."""
+    if os.path.isdir(path):
+        shards = _dir_shard_files(path)
+        assert shards, f"{path}: no shard files to lose"
+        for p in shards[::2]:
+            os.unlink(p)
+    else:
+        os.unlink(path)
 
 
 class FaultInjector:
@@ -101,10 +164,13 @@ class FaultInjector:
     # -- loop hook points --------------------------------------------------
 
     def before_step(self, gstep: int) -> None:
-        """Crash/preemption faults, delivered before the step runs."""
+        """Crash/preemption faults, delivered before the step runs.  A
+        ``reshape`` fault is a preemption here — the geometry change it
+        declares happens at RESUME time (the drill runner applies
+        ``spec.opts`` to the resume leg's flags)."""
         if self._fire("raise", gstep):
             raise FaultInjected(f"injected crash before step {gstep}")
-        if self._fire("sigterm", gstep):
+        if self._fire("sigterm", gstep) or self._fire("reshape", gstep):
             os.kill(os.getpid(), signal.SIGTERM)
 
     def poison_batch(self, gstep: int, x: Any) -> Any:
@@ -121,18 +187,22 @@ class FaultInjector:
         return loss
 
     def after_save(self, step_id: int, path: Optional[str]) -> None:
-        """``corrupt_ckpt``: fires on the first save at or after the spec
-        step (saves land on epoch boundaries, not every step)."""
+        """``corrupt_ckpt`` / ``lost_shard_files``: fires on the first save
+        at or after the spec step (saves land on epoch boundaries, not
+        every step)."""
         if (
             self.spec is not None
-            and self.spec.kind == "corrupt_ckpt"
+            and self.spec.kind in CKPT_FAULT_KINDS
             and not self.fired
             and step_id >= self.spec.step
             and path is not None
             and os.path.exists(path)
         ):
             self.fired = True
-            corrupt_file(path)
+            if self.spec.kind == "corrupt_ckpt":
+                corrupt_file(path)
+            else:
+                lose_shard_files(path)
 
     def stall_seconds(self, gstep: int) -> float:
         """Called by the data producer for each batch index; nonzero means

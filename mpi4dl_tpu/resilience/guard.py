@@ -51,11 +51,21 @@ class AnomalyGuard:
     (step is good).  ``note_rollback`` counts recoveries and raises
     :class:`AnomalyError` once ``max_rollbacks`` is exceeded — a run that
     keeps tripping is not transient and must fail loudly.
+
+    The rollback count DECAYS: every ``rollback_decay_steps`` consecutive
+    good steps forgive one past rollback (ISSUE 13 satellite).  Without
+    decay the counter was lifetime-monotone, so a long run with rare,
+    individually-recoverable NaNs eventually fail-fasted anyway; with it,
+    only CLUSTERED anomalies — more than ``max_rollbacks`` without a
+    ``rollback_decay_steps``-long clean stretch between them — trip the
+    fail-fast.  ``rollback_decay_steps=0`` restores the lifetime counter.
     """
 
     grad_norm_limit: float = 0.0  # 0 = grad-norm check off
     max_rollbacks: int = 3
     rollbacks: int = 0
+    rollback_decay_steps: int = 64  # good steps that forgive one rollback
+    good_streak: int = 0
 
     @classmethod
     def from_env(cls) -> Optional["AnomalyGuard"]:
@@ -69,6 +79,19 @@ class AnomalyGuard:
 
     def check(self, loss: float,
               metrics: Optional[Dict[str, Any]] = None) -> Optional[str]:
+        reason = self._check(loss, metrics)
+        if reason is not None:
+            self.good_streak = 0
+            return reason
+        self.good_streak += 1
+        if (self.rollback_decay_steps > 0 and self.rollbacks > 0
+                and self.good_streak >= self.rollback_decay_steps):
+            self.rollbacks -= 1
+            self.good_streak = 0
+        return None
+
+    def _check(self, loss: float,
+               metrics: Optional[Dict[str, Any]] = None) -> Optional[str]:
         if not math.isfinite(loss):
             return f"non-finite loss {loss}"
         if self.grad_norm_limit > 0 and metrics is not None:
@@ -89,6 +112,7 @@ class AnomalyGuard:
         if self.rollbacks > self.max_rollbacks:
             raise AnomalyError(
                 f"{self.rollbacks} rollbacks exceed max_rollbacks="
-                f"{self.max_rollbacks}: anomalies are persistent, not "
-                "transient — failing fast"
+                f"{self.max_rollbacks} without a {self.rollback_decay_steps}"
+                "-good-step clean stretch between them: anomalies are "
+                "clustered, not transient — failing fast"
             )

@@ -22,6 +22,9 @@ Event records written to the RunLog (see docs/resilience.md):
 - ``recovery`` — state rolled back; the poison batch is skipped
 - ``preempt``  — SIGTERM/SIGINT honored: in-flight step finished, state
   saved, loop exited cleanly
+- ``checkpoint`` — one completed save: gather/write ms, bytes, shard
+  count, peak pending host bytes (ISSUE 13: checkpoint stalls become
+  observable instead of mystery gaps in the step stream)
 """
 
 from __future__ import annotations
@@ -33,7 +36,7 @@ from typing import Any, Callable, Dict, Optional
 
 from mpi4dl_tpu.checkpoint import CheckpointManager, arrays_to_state, state_to_arrays
 from mpi4dl_tpu.data import prefetch_batches
-from mpi4dl_tpu.resilience.faults import FaultInjector
+from mpi4dl_tpu.resilience.faults import CKPT_FAULT_KINDS, FaultInjector
 from mpi4dl_tpu.resilience.guard import AnomalyError, AnomalyGuard
 from mpi4dl_tpu.resilience.preempt import PreemptionHandler
 from mpi4dl_tpu.resilience.watchdog import StepWatchdog
@@ -101,16 +104,26 @@ def run_supervised(
     preempted = False
     steps_run = 0
 
+    def _ckpt_record(stats) -> None:
+        """Emit the ``checkpoint`` RunLog record (worker thread for async
+        saves, training thread for sync ones — RunLog.write is locked)."""
+        if runlog is not None and stats is not None:
+            runlog.write("checkpoint", **stats.record())
+
     writer = (
-        AsyncCheckpointWriter(ckpt) if (ckpt is not None and async_writes)
-        else None
+        AsyncCheckpointWriter(ckpt, on_saved=_ckpt_record)
+        if (ckpt is not None and async_writes) else None
     )
 
     def _save(st: Any, step_id: int) -> Optional[str]:
         if ckpt is None:
             return None
-        path = writer.save(st, step_id) if writer else ckpt.save(st, step_id)
-        if faults.spec is not None and faults.spec.kind == "corrupt_ckpt":
+        if writer:
+            path = writer.save(st, step_id)
+        else:
+            path = ckpt.save(st, step_id)
+            _ckpt_record(ckpt.last_save_stats)
+        if faults.spec is not None and faults.spec.kind in CKPT_FAULT_KINDS:
             if writer is not None:
                 writer.flush()  # the fault corrupts a file, not a queue entry
             faults.after_save(step_id, path)
@@ -140,10 +153,20 @@ def run_supervised(
 
     from mpi4dl_tpu.obs import step_annotation  # deferred: pulls in jax
 
-    def _last_record():
-        return getattr(runlog, "last_record", None) if runlog is not None else None
+    def _wd_context():
+        """Stall-dump context: the last record of any kind PLUS the last
+        ``checkpoint`` record, so a stall inside the shard-gather is
+        distinguishable from a data stall."""
+        if runlog is None:
+            return None
+        return {
+            "last": getattr(runlog, "last_record", None),
+            "last_checkpoint": getattr(runlog, "last_by_kind", {}).get(
+                "checkpoint"
+            ),
+        }
 
-    watchdog = StepWatchdog(watchdog_secs, get_context=_last_record)
+    watchdog = StepWatchdog(watchdog_secs, get_context=_wd_context)
     preempt = (
         PreemptionHandler() if handle_signals else PreemptionHandler(())
     )

@@ -5,9 +5,13 @@ training job hang until the scheduler kills it — with nothing on stderr to
 debug from.  The watchdog is a monitor thread the supervised loop arms at
 the start of each step (covering the batch fetch AND the device step) and
 disarms after; if the armed deadline passes, it dumps every live Python
-thread's stack plus the last RunLog record to stderr, once per armed step,
-and keeps monitoring.  It never kills the job — it makes the eventual death
-diagnosable.
+thread's stack, the last RunLog record (including the last ``checkpoint``
+record when the loop provides it), and live device/host memory stats to
+stderr, once per armed step, and keeps monitoring.  The memory lines plus
+the checkpoint record make a stall inside the shard-gather (host RSS
+climbing, a ``checkpoint`` record with no successor step) distinguishable
+from a data stall (ISSUE 13 satellite).  It never kills the job — it makes
+the eventual death diagnosable.
 
 Budget resolution: the ``--watchdog-secs`` flag, else the
 ``MPI4DL_WATCHDOG_SECS`` hatch, else 0 (off).
@@ -29,6 +33,41 @@ def watchdog_budget_from_env(flag_value: Optional[float] = None) -> float:
     if flag_value is not None:
         return float(flag_value)
     return float(os.environ.get("MPI4DL_WATCHDOG_SECS", "0") or 0.0)
+
+
+def memory_report_lines() -> list:
+    """Live memory evidence for the stall dump: host RSS peak plus per-
+    device allocator stats where the backend reports them (TPU/GPU; CPU
+    devices have no allocator stats — the host line still lands).  Never
+    raises, never imports jax unless it is already importable."""
+    lines = []
+    try:
+        from mpi4dl_tpu.obs.runlog import host_rss_peak_bytes
+
+        rss = host_rss_peak_bytes()
+        if rss is not None:
+            lines.append(f"host rss peak: {rss / 2**30:.2f} GiB")
+    except Exception as e:  # noqa: BLE001 — diagnostics must not raise
+        lines.append(f"host rss unavailable: {e!r}")
+    try:
+        import jax
+
+        for d in jax.devices()[:16]:
+            stats = getattr(d, "memory_stats", lambda: None)() or {}
+            if stats:
+                lines.append(
+                    f"device {d.id} ({d.platform}): "
+                    f"in_use={stats.get('bytes_in_use')} "
+                    f"peak={stats.get('peak_bytes_in_use')} "
+                    f"limit={stats.get('bytes_limit')}"
+                )
+        if len(lines) <= 1:
+            lines.append(
+                "device allocator stats: none reported (CPU backend)"
+            )
+    except Exception as e:  # noqa: BLE001 — diagnostics must not raise
+        lines.append(f"device memory stats unavailable: {e!r}")
+    return lines
 
 
 def dump_stacks(out) -> None:
@@ -120,10 +159,24 @@ class StepWatchdog:
                 ctx = self.get_context()
             except Exception as e:
                 ctx = f"<context unavailable: {e!r}>"
-            if ctx is not None:
+            # The loop passes {"last": <record>, "last_checkpoint":
+            # <record>} so a stalled shard-gather is identifiable by its
+            # checkpoint record; plain records render on one line.
+            if isinstance(ctx, dict) and "last" in ctx:
+                for key, rec in ctx.items():
+                    if rec is not None:
+                        out.write(f"{key} runlog record: {json.dumps(rec)}\n")
+            elif ctx is not None:
                 rendered = (
                     json.dumps(ctx) if isinstance(ctx, dict) else str(ctx)
                 )
                 out.write(f"last runlog record: {rendered}\n")
+        # Stacks FIRST: memory_report_lines queries the device runtime, and
+        # a wedged runtime is exactly what may have tripped the watchdog —
+        # the primary diagnostic must already be on stderr if that call
+        # never returns.
         dump_stacks(out)
+        out.flush()
+        for line in memory_report_lines():
+            out.write(f"memory: {line}\n")
         out.flush()

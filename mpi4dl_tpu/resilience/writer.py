@@ -1,58 +1,170 @@
-"""Background checkpoint writer (ISSUE 3 component 2, I/O half).
+"""Background checkpoint writer (ISSUE 3 component 2, I/O half; sharded
+streaming in ISSUE 13).
 
 A synchronous ``CheckpointManager.save`` stalls the training loop for the
 full serialize+fsync of every leaf — at pathology scales (ResNet@2k-8k
 inputs, flat stage buffers) that is seconds per save on network disks.  The
-split: ``jax.device_get`` MUST happen on the training thread (the very next
-step donates the state buffers), but npz serialization, fsync, and the
-atomic rename are pure host I/O — they move to one worker thread with a
-small bounded queue.
+split: the device→host gathers MUST happen on the training thread (the very
+next step donates the state buffers), but file writes, fsync, and the
+atomic rename are pure host I/O — they move to one worker thread.
 
-Failure semantics: a worker-side error is latched and re-raised on the NEXT
-``save``/``flush``/``close`` on the training thread — checkpoint loss must
-fail the run loudly, never silently.  ``flush()`` blocks until every queued
-write hit disk (the loop calls it before restore-for-rollback and before a
-preemption exit, so "saved" always means durable at those points).
+Under the sharded (v2) format the handoff is PER SHARD, not per state: the
+training thread gathers one shard at a time and enqueues it; the worker
+writes and frees it.  A byte budget (``max_pending_bytes``, default the
+``MPI4DL_CKPT_HOST_BYTES`` hatch) bounds how many gathered-but-unwritten
+bytes may exist at once — the training thread blocks (backpressure) instead
+of materializing the full state on the host, so peak host RSS during a save
+is O(budget + largest shard), not O(full state).  ``peak_pending_bytes``
+records the realized watermark for the ``checkpoint`` RunLog record and the
+memory-bound regression test.
+
+Failure semantics: a worker-side error aborts the in-flight transaction
+(its hidden tmp directory is removed — never a torn published checkpoint),
+is latched, and re-raised on the NEXT ``save``/``flush``/``close`` on the
+training thread — checkpoint loss must fail the run loudly, never silently.
+``flush()`` blocks until every queued write hit disk (the loop calls it
+before restore-for-rollback and before a preemption exit, so "saved" always
+means durable at those points).
 """
 
 from __future__ import annotations
 
+import itertools
+import os
 import queue
 import threading
-from typing import Any, Optional
+import time
+from typing import Any, Callable, Optional
 
-from mpi4dl_tpu.checkpoint import CheckpointManager, state_to_arrays
+from mpi4dl_tpu.checkpoint import (
+    CheckpointManager,
+    SaveStats,
+    state_shard_plan,
+    state_to_arrays,
+)
+
+# Default gathered-but-unwritten byte budget when the hatch is unset.
+DEFAULT_PENDING_BYTES = 1 << 30
+
+
+def pending_bytes_budget(flag_value: Optional[int] = None) -> int:
+    """Resolve the host-byte budget: explicit value wins, then the
+    ``MPI4DL_CKPT_HOST_BYTES`` hatch, then 1 GiB."""
+    if flag_value is not None:
+        return int(flag_value)
+    val = int(os.environ.get("MPI4DL_CKPT_HOST_BYTES", "0") or 0)
+    return val if val > 0 else DEFAULT_PENDING_BYTES
 
 
 class CheckpointWriteError(RuntimeError):
     """A background checkpoint write failed (original error chained)."""
 
 
+class _ByteBudget:
+    """Counting semaphore over bytes with a watermark.  A single item larger
+    than the whole budget is admitted alone (otherwise it could never be
+    saved); everything else blocks until the worker drains."""
+
+    def __init__(self, limit: int):
+        self.limit = max(1, int(limit))
+        self.used = 0
+        self.peak = 0
+        self._cond = threading.Condition()
+
+    def acquire(self, n: int) -> int:
+        """Admit ``n`` bytes; returns the post-acquire outstanding total (the
+        caller's per-save watermark sample)."""
+        with self._cond:
+            while self.used > 0 and self.used + n > self.limit:
+                self._cond.wait()
+            self.used += n
+            self.peak = max(self.peak, self.used)
+            return self.used
+
+    def release(self, n: int) -> None:
+        with self._cond:
+            self.used -= n
+            self._cond.notify_all()
+
+
 class AsyncCheckpointWriter:
-    """Two-phase async saves over a :class:`CheckpointManager`."""
+    """Two-phase async saves over a :class:`CheckpointManager`.
+
+    ``on_saved`` (optional) is called on the worker thread with the final
+    :class:`SaveStats` after each checkpoint is durably committed — the
+    supervised loop uses it to emit the ``checkpoint`` RunLog record."""
 
     _SENTINEL = object()
 
-    def __init__(self, manager: CheckpointManager, max_pending: int = 2):
+    def __init__(self, manager: CheckpointManager, max_pending: int = 2,
+                 max_pending_bytes: Optional[int] = None,
+                 on_saved: Optional[Callable[[SaveStats], None]] = None):
         self.manager = manager
-        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, max_pending))
+        self.on_saved = on_saved
+        self.budget = _ByteBudget(pending_bytes_budget(max_pending_bytes))
+        # The byte budget is the real backpressure for BOTH formats (npz
+        # whole-state payloads acquire their full size); the item queue
+        # bound only caps bookkeeping tuples.
+        self._q: "queue.Queue" = queue.Queue(
+            maxsize=max(64, max(1, max_pending) * 64)
+        )
         self._error: Optional[BaseException] = None
+        # Dead transactions are tracked by a per-save sequence number, NOT
+        # id(txn): an aborted txn is garbage-collected and a later one can
+        # reuse its address, which would silently drop every shard of the
+        # new save.  Sequence numbers are never reused.
+        self._seq = itertools.count()
+        self._dead_txns: set = set()
         self._closed = False
         self._thread = threading.Thread(
             target=self._worker, name="mpi4dl-ckpt-writer", daemon=True
         )
         self._thread.start()
 
+    @property
+    def peak_pending_bytes(self) -> int:
+        """Writer-lifetime watermark of gathered-but-unwritten host bytes."""
+        return self.budget.peak
+
     def save(self, state: Any, step_id: int) -> str:
-        """Gather on the calling thread, enqueue the write; returns the
-        path the checkpoint WILL land at.  Blocks only when ``max_pending``
-        writes are already in flight (backpressure beats unbounded RAM)."""
+        """Gather on the calling thread (shard-by-shard under the byte
+        budget for sharded managers; whole-state for npz), enqueue the
+        writes; returns the path the checkpoint WILL land at."""
         self._check()
         if self._closed:
             raise CheckpointWriteError("writer is closed")
-        arrays = state_to_arrays(state, step_id)
-        self._q.put((arrays, step_id))
-        return self.manager.path_for(step_id)
+        if self.manager.format != "sharded":
+            arrays = state_to_arrays(state, step_id)
+            nbytes = sum(int(a.nbytes) for a in arrays.values())
+            self.budget.acquire(nbytes)
+            self._q.put(("npz", arrays, step_id, nbytes))
+            return self.manager.path_for(step_id)
+        txn = self.manager.begin_save(step_id)
+        seq = next(self._seq)
+        try:
+            for leaf_id, meta, entries in state_shard_plan(state):
+                txn.add_leaf(leaf_id, meta)
+                for offset, gather in entries:
+                    self._check()
+                    t0 = time.perf_counter()
+                    arr = gather()
+                    txn.stats.gather_ms += (time.perf_counter() - t0) * 1e3
+                    nbytes = int(arr.nbytes)
+                    outstanding = self.budget.acquire(nbytes)
+                    txn.stats.peak_pending_bytes = max(
+                        txn.stats.peak_pending_bytes, outstanding
+                    )
+                    self._q.put(("shard", seq, txn, leaf_id, offset, arr,
+                                 nbytes))
+                    del arr
+        except BaseException:
+            # The worker may hold queued shards of this txn; mark it dead so
+            # they are skipped (and their budget released), then abort.
+            self._dead_txns.add(seq)
+            txn.abort()
+            raise
+        self._q.put(("commit", seq, txn))
+        return txn.path
 
     def flush(self) -> None:
         """Block until every queued write is durable; raise on any failure."""
@@ -81,8 +193,35 @@ class AsyncCheckpointWriter:
             try:
                 if item is self._SENTINEL:
                     return
-                arrays, step_id = item
-                self.manager.save_arrays(arrays, step_id)
+                kind = item[0]
+                if kind == "npz":
+                    _, arrays, step_id, nbytes = item
+                    try:
+                        self.manager.save_arrays(arrays, step_id)
+                    finally:
+                        self.budget.release(nbytes)
+                    if self.on_saved and self.manager.last_save_stats:
+                        self.on_saved(self.manager.last_save_stats)
+                elif kind == "shard":
+                    _, seq, txn, leaf_id, offset, arr, nbytes = item
+                    try:
+                        if seq not in self._dead_txns:
+                            try:
+                                txn.add_shard(leaf_id, offset, arr)
+                            except BaseException:
+                                self._dead_txns.add(seq)
+                                txn.abort()
+                                raise
+                    finally:
+                        self.budget.release(nbytes)
+                elif kind == "commit":
+                    _, seq, txn = item
+                    if seq not in self._dead_txns:
+                        stats = self.manager.finish_save(txn)
+                        if self.on_saved:
+                            self.on_saved(stats)
+                    else:
+                        self._dead_txns.discard(seq)
             except BaseException as e:  # latched for the training thread
                 self._error = e
             finally:

@@ -84,11 +84,31 @@ def test_manager_keep_and_latest(tmp_path):
     state = {"w": jnp.ones((3,))}
     for sid in (1, 2, 3):
         mgr.save(state, step_id=sid)
-    assert mgr.latest_path().endswith("ckpt_3.npz")
+    assert mgr.latest_path().endswith("ckpt_3")
     import os
 
     files = sorted(os.listdir(tmp_path))
-    assert files == ["ckpt_2.npz", "ckpt_3.npz"]
+    assert files == ["ckpt_2", "ckpt_3"]  # sharded dirs, oldest pruned
+
+
+def test_manager_npz_format_compat(tmp_path):
+    """format='npz' keeps the v1 single-file layout, and a sharded manager
+    restores v1 files (mixed directories walk across formats)."""
+    import os
+
+    v1 = CheckpointManager(str(tmp_path), format="npz")
+    v1.save({"w": jnp.arange(3.0)}, step_id=1)
+    assert sorted(os.listdir(tmp_path)) == ["ckpt_1.npz"]
+    mixed = CheckpointManager(str(tmp_path))  # sharded writer, dual reader
+    mixed.save({"w": jnp.arange(3.0) * 2}, step_id=2)
+    state, step_id = mixed.restore_latest({"w": jnp.zeros((3,))})
+    assert step_id == 2
+    from mpi4dl_tpu.resilience import corrupt_file
+
+    corrupt_file(mixed.latest_path())  # newest (sharded) falls back to v1
+    state, step_id = mixed.restore_latest({"w": jnp.zeros((3,))})
+    assert step_id == 1
+    np.testing.assert_array_equal(np.asarray(state["w"]), np.arange(3.0))
 
 
 def test_restore_rejects_mismatched_shapes(tmp_path):
@@ -184,3 +204,306 @@ def test_restore_latest_empty_dir_fresh_start(tmp_path):
     template = {"w": jnp.ones((3,))}
     state, step_id = mgr.restore_latest(template)
     assert step_id == 0 and state is template
+
+
+# ---------------------------------------------------------------------------
+# Sharded format v2 + elastic restore (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_manifest_offsets_and_crcs(tmp_path, devices8):
+    """Each leaf is written as its unique addressable shards keyed by
+    GLOBAL offsets, each with its own CRC32; replicas are deduplicated."""
+    import json
+    import os
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from mpi4dl_tpu.checkpoint import SHARD_MANIFEST, load_sharded_arrays
+    from mpi4dl_tpu.mesh import MeshSpec, build_mesh
+
+    mesh = build_mesh(MeshSpec(stage=2, sph=2, spw=2), jax.devices()[:8])
+    w = jax.device_put(
+        jnp.arange(64.0).reshape(8, 8), NamedSharding(mesh, P("stage", None))
+    )
+    rep = jax.device_put(jnp.arange(6.0), NamedSharding(mesh, P()))
+    mgr = CheckpointManager(str(tmp_path))
+    path = mgr.save({"w": w, "rep": rep}, 4)
+
+    manifest = json.load(open(os.path.join(path, SHARD_MANIFEST)))
+    assert manifest["schema"] == 2 and manifest["step_id"] == 4
+    by_nshards = sorted(len(l["shards"]) for l in manifest["leaves"])
+    assert by_nshards == [1, 2]  # replicated leaf deduped; 2 stage rows
+    sharded_leaf = next(l for l in manifest["leaves"]
+                        if len(l["shards"]) == 2)
+    assert [s["offset"] for s in sharded_leaf["shards"]] == [[0, 0], [4, 0]]
+    assert all(isinstance(s["crc32"], int) for s in sharded_leaf["shards"])
+    # save cost accounting for the RunLog `checkpoint` record
+    stats = mgr.last_save_stats
+    assert stats.shards == 3 and stats.bytes > 0
+    assert stats.gather_ms >= 0 and stats.write_ms > 0
+
+    arrays, step_id = load_sharded_arrays(path)
+    assert step_id == 4
+    w_leaf = manifest["leaves"].index(sharded_leaf)
+    np.testing.assert_array_equal(
+        arrays[f"leaf_{w_leaf}"], np.arange(64.0).reshape(8, 8)
+    )
+
+
+def test_elastic_restore_cross_mesh(tmp_path, devices8):
+    """THE elastic-restore contract at the leaf level: a checkpoint saved
+    under one mesh layout restores bit-identically under a template built
+    on a DIFFERENT mesh shape, and the restored leaves carry the TARGET
+    shardings.  Identity must match; layout skew is allowed and flagged."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from mpi4dl_tpu.checkpoint import split_config_fingerprint
+    from mpi4dl_tpu.mesh import MeshSpec, build_mesh
+
+    spec_a, spec_b = MeshSpec(stage=2, sph=2, spw=2), MeshSpec(stage=2, sph=4, spw=1)
+    mesh_a = build_mesh(spec_a, jax.devices()[:8])
+    mesh_b = build_mesh(spec_b, jax.devices()[:8])
+    cfg_a = {"model": "resnet", "seed": 0, "slice_method": "square", "parts": 4}
+    cfg_b = {"model": "resnet", "seed": 0, "slice_method": "horizontal", "parts": 2}
+    ia, la, da = split_config_fingerprint(cfg_a, spec_a)
+    ib, lb, db = split_config_fingerprint(cfg_b, spec_b)
+    assert ia == ib and la != lb  # same model, different layout
+
+    w = jax.device_put(jnp.arange(64.0).reshape(8, 8),
+                       NamedSharding(mesh_a, P("stage", None)))
+    tiles = jax.device_put(jnp.arange(16.0).reshape(4, 4),
+                           NamedSharding(mesh_a, P(("sph", "spw"), None)))
+    saver = CheckpointManager(str(tmp_path), identity=ia, layout=la,
+                              layout_desc=da)
+    saver.save({"w": w, "t": tiles}, 7)
+
+    template = {
+        "w": jax.device_put(jnp.zeros((8, 8)),
+                            NamedSharding(mesh_b, P("stage", None))),
+        "t": jax.device_put(jnp.zeros((4, 4)),
+                            NamedSharding(mesh_b, P("sph", None))),
+    }
+    restorer = CheckpointManager(str(tmp_path), identity=ib, layout=lb,
+                                 layout_desc=db)
+    state, step_id = restorer.restore_latest(template)
+    assert step_id == 7
+    assert restorer.last_restore.elastic
+    assert restorer.last_restore.saved_layout["slice_method"] == "square"
+    np.testing.assert_array_equal(np.asarray(state["w"]),
+                                  np.arange(64.0).reshape(8, 8))
+    np.testing.assert_array_equal(np.asarray(state["t"]),
+                                  np.arange(16.0).reshape(4, 4))
+    assert state["w"].sharding == template["w"].sharding  # target mesh
+    # Same-geometry restore stays non-elastic (v1-equivalent behavior).
+    again = CheckpointManager(str(tmp_path), identity=ia, layout=la)
+    _, sid = again.restore_latest({"w": w, "t": tiles})
+    assert sid == 7 and not again.last_restore.elastic
+
+
+def test_elastic_restore_identity_mismatch_still_hard(tmp_path):
+    """Layout may differ; model identity may NOT."""
+    from mpi4dl_tpu.checkpoint import CheckpointMismatch, split_config_fingerprint
+
+    ia, la, da = split_config_fingerprint({"model": "resnet", "parts": 2})
+    ib, lb, _ = split_config_fingerprint({"model": "amoebanet", "parts": 4})
+    saver = CheckpointManager(str(tmp_path), identity=ia, layout=la,
+                              layout_desc=da)
+    saver.save({"w": jnp.ones((3,))}, 1)
+    with pytest.raises(CheckpointMismatch):
+        CheckpointManager(str(tmp_path), identity=ib,
+                          layout=lb).restore_latest({"w": jnp.ones((3,))})
+
+
+def test_elastic_restore_shape_change_is_typed_error(tmp_path):
+    """A layout change that re-packs leaf shapes cannot restore elastically:
+    the cheap pass raises a typed CheckpointMismatch naming the leaf."""
+    from mpi4dl_tpu.checkpoint import CheckpointMismatch, split_config_fingerprint
+
+    ia, la, da = split_config_fingerprint({"model": "r", "spatial_until": 5})
+    _, lb, _ = split_config_fingerprint({"model": "r", "spatial_until": 9})
+    saver = CheckpointManager(str(tmp_path), identity=ia, layout=la,
+                              layout_desc=da)
+    saver.save({"buf": jnp.ones((6,))}, 1)
+    with pytest.raises(CheckpointMismatch, match="not leaf-shape-preserving"):
+        CheckpointManager(str(tmp_path), identity=ia,
+                          layout=lb).restore_latest({"buf": jnp.ones((8,))})
+
+
+def test_quant_policy_change_is_reshape_not_drift(tmp_path):
+    """The resolved quant policy lives in the LAYOUT fingerprint: resuming
+    with a different --quant is an elastic reshape (flagged), never a
+    silent same-layout restore."""
+    from mpi4dl_tpu.checkpoint import split_config_fingerprint
+
+    i8, l8, d8 = split_config_fingerprint(
+        {"model": "r"}, extra_layout={"quant_resolved": "junction=int8"})
+    ioff, loff, doff = split_config_fingerprint(
+        {"model": "r"}, extra_layout={"quant_resolved": "off"})
+    assert i8 == ioff and l8 != loff
+    CheckpointManager(str(tmp_path), identity=i8, layout=l8,
+                      layout_desc=d8).save({"w": jnp.ones((3,))}, 2)
+    r = CheckpointManager(str(tmp_path), identity=ioff, layout=loff,
+                          layout_desc=doff)
+    _, sid = r.restore_latest({"w": jnp.zeros((3,))})
+    assert sid == 2 and r.last_restore.elastic
+    assert r.last_restore.saved_layout["quant_resolved"] == "junction=int8"
+
+
+def test_cheap_validation_reads_no_array_bytes(tmp_path, monkeypatch):
+    """Walking past a torn checkpoint is manifest-first: the rejected
+    candidates cost a manifest read + stat pass, never a shard read; a
+    template-shape mismatch is also detected without array bytes."""
+    import os
+
+    from mpi4dl_tpu import checkpoint as ckpt_mod
+    from mpi4dl_tpu.checkpoint import CheckpointMismatch
+
+    reads = []
+    real = ckpt_mod._read_shard_bytes
+    monkeypatch.setattr(ckpt_mod, "_read_shard_bytes",
+                        lambda p: (reads.append(p) or real(p)))
+
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save({"w": jnp.arange(1024.0)}, 1)
+    p2 = mgr.save({"w": jnp.arange(1024.0) * 2}, 2)
+    shard = next(os.path.join(p2, f) for f in sorted(os.listdir(p2))
+                 if f.endswith(".bin"))
+    with open(shard, "r+b") as f:  # torn multi-KB shard
+        f.truncate(os.path.getsize(shard) // 2)
+
+    _, step_id = mgr.restore_latest({"w": jnp.zeros((1024,))})
+    assert step_id == 1
+    # exactly the surviving checkpoint's single shard was read — the torn
+    # ckpt_2 was rejected by the stat pass
+    assert len(reads) == 1 and os.path.dirname(reads[0]).endswith("ckpt_1")
+
+    reads.clear()
+    with pytest.raises(CheckpointMismatch):
+        mgr.restore_latest({"w": jnp.zeros((7,))})  # wrong template shape
+    assert reads == []  # mismatch detected from the manifest alone
+
+
+def test_cheap_validation_npz_truncated(tmp_path):
+    """v1 npz: truncation fails the zip-directory read in the cheap pass."""
+    import os
+
+    from mpi4dl_tpu.checkpoint import cheap_validate
+
+    path = str(tmp_path / "ckpt_1.npz")
+    save_state(path, {"w": jnp.arange(4096.0)}, 1)
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) // 3)
+    with pytest.raises(CheckpointInvalid):
+        cheap_validate(path)
+
+
+def test_sync_sharded_save_memory_is_one_shard(tmp_path, devices8):
+    """The sync sharded save's peak host materialization is O(largest
+    shard): the stats watermark equals the largest shard, far under the
+    full state size."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from mpi4dl_tpu.mesh import MeshSpec, build_mesh
+
+    mesh = build_mesh(MeshSpec(stage=8), jax.devices()[:8])
+    big = jax.device_put(jnp.ones((8, 4096), jnp.float32),
+                         NamedSharding(mesh, P("stage", None)))
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save({"big": big, "big2": big + 1}, 1)
+    stats = mgr.last_save_stats
+    total = 2 * 8 * 4096 * 4
+    assert stats.bytes == total and stats.shards == 16
+    assert stats.peak_pending_bytes == 4096 * 4  # one stage row
+
+
+@pytest.mark.slow
+def test_elastic_restore_sp_pipeline_reshape(tmp_path, devices8):
+    """End-to-end reshape-restore through the benchmark entry point: save
+    under SP(2×2)×PP(2) parts=4, resume under SP(4×1)×PP(2) parts=2.  The
+    restore point is leaf-bit-identical (checked directly against the
+    saved checkpoint), training continues, and the final loss matches a
+    target-geometry control within tolerance (parts changes micro-batch BN
+    statistics, so bit-identity across the reshape is not promised)."""
+    import os
+
+    from benchmarks.common import run
+    from mpi4dl_tpu.checkpoint import load_arrays
+
+    def argv(ck, extra):
+        return [
+            "--image-size", "32", "--num-layers", "1", "--batch-size", "4",
+            "--steps-per-epoch", "2", "--num-epochs", "2",
+            "--split-size", "2", "--checkpoint-dir", str(tmp_path / ck),
+        ] + extra
+
+    geo_a = ["--slice-method", "square", "--parts", "4"]
+    geo_b = ["--slice-method", "horizontal", "--parts", "2"]
+
+    control_b = run("sp", "resnet", argv("ck_control", geo_b))
+
+    os.environ["MPI4DL_FAULT"] = "reshape@2:slice-method=horizontal,parts=2"
+    try:
+        killed = run("sp", "resnet", argv("ck_reshape", geo_a))
+    finally:
+        del os.environ["MPI4DL_FAULT"]
+    assert killed["preempted"] and killed["final_step"] == 3
+
+    # Leaf-level bit-identity at the restore point: what geometry B's
+    # manager hands back equals what geometry A wrote, byte for byte.
+    saved_arrays, saved_step = load_arrays(
+        str(tmp_path / "ck_reshape" / "ckpt_3"))
+    assert saved_step == 3
+
+    resumed = run("sp", "resnet", argv("ck_reshape", geo_b))
+    assert resumed["elastic"], "layout skew must be an ELASTIC restore"
+    assert resumed["start_step"] == 3 and resumed["final_step"] == 4
+    # The resume leg re-saved at step 4 under geometry B; its step-3 source
+    # leaves must survive the round trip through the elastic re-placement.
+    resaved, _ = load_arrays(str(tmp_path / "ck_reshape" / "ckpt_4"))
+    assert sorted(saved_arrays) == sorted(resaved)
+
+    a, b = resumed["loss"], control_b["loss"]
+    assert abs(a - b) <= 0.05 * max(abs(a), abs(b), 1e-6), (
+        f"reshape-resumed loss {a} vs target-geometry control {b}"
+    )
+
+
+def test_resave_same_step_swaps_safely(tmp_path):
+    """Re-saving an existing step id (a boundary re-reached after rollback)
+    publishes the new version and leaves no hidden work dirs behind."""
+    import os
+
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save({"w": jnp.full((4,), 1.0)}, step_id=2)
+    mgr.save({"w": jnp.full((4,), 9.0)}, step_id=2)
+    state, step_id = mgr.restore_latest({"w": jnp.zeros((4,))})
+    assert step_id == 2
+    np.testing.assert_array_equal(np.asarray(state["w"]), np.full((4,), 9.0))
+    assert sorted(os.listdir(tmp_path)) == ["ckpt_2"]  # no .tmp/.old strays
+
+
+def test_manager_init_reclaims_stranded_work_dirs(tmp_path):
+    """Hidden .tmp_ckpt_*/.old_ckpt_* dirs from a hard crash are reclaimed
+    at manager construction."""
+    import os
+
+    (tmp_path / ".tmp_ckpt_3_x").mkdir()
+    (tmp_path / ".old_ckpt_3_y").mkdir()
+    CheckpointManager(str(tmp_path))
+    assert sorted(os.listdir(tmp_path)) == []
+
+
+def test_load_arrays_vanished_shard_is_checkpoint_invalid(tmp_path):
+    """A shard file that vanishes between manifest read and shard read
+    surfaces as CheckpointInvalid through the public load API, not a raw
+    OSError."""
+    import os
+
+    mgr = CheckpointManager(str(tmp_path))
+    path = mgr.save({"w": jnp.arange(8.0)}, 1)
+    shard = next(os.path.join(path, f) for f in sorted(os.listdir(path))
+                 if f.endswith(".bin"))
+    os.unlink(shard)
+    with pytest.raises(CheckpointInvalid, match="unreadable|missing"):
+        load_arrays(path)

@@ -1,0 +1,168 @@
+"""Mesh-fault drill harness (mpi4dl_tpu/resilience/drill.py, ISSUE 13):
+the scenario runner must PROVE recovery — exact/tolerance loss checks
+against a control, no silent fresh-starts, typed verdicts — and the full
+toy matrix must end green through the real loop/checkpoint machinery."""
+
+from __future__ import annotations
+
+import pytest
+
+from mpi4dl_tpu.obs import RunLog, read_runlog
+from mpi4dl_tpu.resilience.drill import (
+    DrillVerdict,
+    Scenario,
+    default_scenarios,
+    parse_reshape_spec,
+    run_drills,
+    run_scenario,
+    toy_runner,
+)
+
+
+def test_parse_reshape_spec():
+    assert parse_reshape_spec("slice-method=horizontal,parts=2") == {
+        "slice-method": "horizontal", "parts": "2",
+    }
+    assert parse_reshape_spec("") == {}
+    with pytest.raises(ValueError):
+        parse_reshape_spec("no-equals-sign")
+
+
+def test_default_scenarios_cover_the_matrix():
+    names = [s.name for s in default_scenarios()]
+    assert names == ["kill_resume", "crash_resume", "corrupt_newest",
+                     "nan_rollback", "lost_shard", "reshape"]
+    reshape = default_scenarios()[-1]
+    assert reshape.fault.startswith("reshape@")
+    assert reshape.resume_overrides  # the geometry skew is applied on resume
+
+
+def test_toy_drill_matrix_green(tmp_path):
+    """Every scenario ends in a verified recovery through the REAL
+    supervised loop + sharded checkpoints (toy step, no mesh compiles),
+    and each emits a typed `drill` RunLog record."""
+    runlog = RunLog(str(tmp_path / "drill.jsonl"))
+    verdicts = run_drills(
+        toy_runner(), default_scenarios(), str(tmp_path),
+        runlog=runlog,
+    )
+    runlog.close()
+    assert all(v.passed for v in verdicts), [
+        (v.scenario, v.kind, v.details) for v in verdicts if not v.passed
+    ]
+    assert all(v.kind == "verified_recovery" for v in verdicts)
+    recs = read_runlog(str(tmp_path / "drill.jsonl"))
+    drills = [r for r in recs if r["kind"] == "drill"]
+    assert len(drills) == 6 and all(r["passed"] for r in drills)
+    summary = [r for r in recs if r["kind"] == "drill_summary"]
+    assert summary and summary[0]["passed"] == 6 and not summary[0]["failed"]
+
+
+# ---------------------------------------------------------------------------
+# The judge itself: failures must be typed and precise, not silent
+# ---------------------------------------------------------------------------
+
+
+def _fake_runner(results):
+    """Runner returning scripted summaries per tag (control/fault/resume)."""
+
+    def runner(tag, *, fault="", ckpt_dir, overrides=None):
+        r = results[tag]
+        if isinstance(r, BaseException):
+            raise r
+        return dict(r)
+
+    return runner
+
+
+_GOOD = {"loss": 1.0, "final_step": 4, "preempted": False, "anomalies": 0,
+         "start_step": 2, "elastic": False}
+
+
+def test_drill_detects_fresh_start(tmp_path):
+    """A resume that silently restarted from step 0 is a FAILURE even when
+    the loss happens to match — progress loss must never read as green."""
+    sc = Scenario("s", fault="sigterm@2", min_resume_start=2)
+    v = run_scenario(_fake_runner({
+        "control": _GOOD,
+        "fault": {**_GOOD, "preempted": True, "final_step": 3},
+        "resume": {**_GOOD, "start_step": 0},
+    }), sc, str(tmp_path))
+    assert not v.passed and v.kind == "fresh_start"
+
+
+def test_drill_detects_drift(tmp_path):
+    sc = Scenario("s", fault="sigterm@2", expect="exact")
+    v = run_scenario(_fake_runner({
+        "control": _GOOD,
+        "fault": {**_GOOD, "preempted": True},
+        "resume": {**_GOOD, "loss": 1.0000001},
+    }), sc, str(tmp_path))
+    assert not v.passed and v.kind == "drift"
+    assert "control" in v.details["reason"]
+
+
+def test_drill_close_tolerance(tmp_path):
+    sc = Scenario("s", fault="sigterm@2", expect="close", rtol=0.05)
+    v = run_scenario(_fake_runner({
+        "control": _GOOD,
+        "fault": {**_GOOD, "preempted": True},
+        "resume": {**_GOOD, "loss": 1.02},
+    }), sc, str(tmp_path))
+    assert v.passed and v.kind == "verified_recovery"
+
+
+def test_drill_detects_fault_not_honored(tmp_path):
+    sc = Scenario("s", fault="sigterm@2")  # fault leg must report preempted
+    v = run_scenario(_fake_runner({
+        "control": _GOOD,
+        "fault": {**_GOOD, "preempted": False},
+        "resume": _GOOD,
+    }), sc, str(tmp_path))
+    assert not v.passed and v.kind == "fault_not_honored"
+
+
+def test_drill_detects_unrecovered_nan(tmp_path):
+    sc = Scenario("s", fault="nan_loss@1", expect="recovered",
+                  fault_outcome="complete", resume=False)
+    v = run_scenario(_fake_runner({
+        "control": _GOOD,
+        "fault": {**_GOOD, "anomalies": 0},
+    }), sc, str(tmp_path))
+    assert not v.passed and v.kind == "not_recovered"
+    nan = run_scenario(_fake_runner({
+        "control": _GOOD,
+        "fault": {**_GOOD, "anomalies": 1, "loss": float("nan")},
+    }), sc, str(tmp_path))
+    assert not nan.passed and nan.kind == "not_recovered"
+
+
+def test_drill_leg_error_is_typed(tmp_path):
+    sc = Scenario("s", fault="sigterm@2")
+    v = run_scenario(_fake_runner({
+        "control": _GOOD,
+        "fault": {**_GOOD, "preempted": True},
+        "resume": OSError("disk gone"),
+    }), sc, str(tmp_path))
+    assert not v.passed and v.kind == "leg_error"
+    assert v.details["leg"] == "resume"
+
+
+def test_drill_verdict_record_shape():
+    v = DrillVerdict("kill_resume", True, "verified_recovery",
+                     {"control_loss": 1.0})
+    rec = v.record()
+    assert rec["scenario"] == "kill_resume" and rec["passed"]
+    assert rec["verdict"] == "verified_recovery"
+
+
+@pytest.mark.slow
+def test_drill_cli_toy(tmp_path, capsys):
+    """The `python -m mpi4dl_tpu.resilience drill --toy` surface: full
+    matrix, RunLog artifact, exit 0."""
+    from mpi4dl_tpu.resilience.__main__ import main
+
+    rc = main(["drill", "--toy", "--out", str(tmp_path / "out")])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "6/6 verified recoveries" in out

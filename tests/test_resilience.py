@@ -110,7 +110,7 @@ def test_supervised_loop_epoch_checkpoints(tmp_path):
              guard=AnomalyGuard())
     mgr = CheckpointManager(str(ckpt_dir))
     # guard baseline at 0, epoch boundaries at 2 and 4 (keep=3)
-    assert mgr.latest_path().endswith("ckpt_4.npz")
+    assert mgr.latest_path().endswith("ckpt_4")
     _, step_id = mgr.restore_latest(_toy_state())
     assert step_id == 4
 
@@ -132,17 +132,30 @@ def test_corrupt_newest_checkpoint_falls_back(tmp_path):
 
 
 def test_torn_newest_checkpoint_falls_back(tmp_path):
-    """Truncation (the classic mid-write kill) is also walked past."""
+    """Truncation (the classic mid-write kill) is also walked past — a torn
+    shard file in the sharded format, a torn zip in v1."""
+    import os
+
     mgr = CheckpointManager(str(tmp_path), keep=3)
     mgr.save({"w": jnp.full((4,), 1.0)}, step_id=1)
     path2 = mgr.save({"w": jnp.full((4,), 2.0)}, step_id=2)
-    import os
-
-    with open(path2, "r+b") as f:
-        f.truncate(os.path.getsize(path2) // 3)
+    shard = next(
+        os.path.join(path2, f) for f in sorted(os.listdir(path2))
+        if f.endswith(".bin")
+    )
+    with open(shard, "r+b") as f:
+        f.truncate(os.path.getsize(shard) // 3)
     state, step_id = mgr.restore_latest(_toy_state())
     assert step_id == 1
     np.testing.assert_array_equal(np.asarray(state["w"]), np.full((4,), 1.0))
+
+    v1 = CheckpointManager(str(tmp_path / "v1"), format="npz")
+    v1.save({"w": jnp.full((4,), 1.0)}, step_id=1)
+    p2 = v1.save({"w": jnp.full((4,), 2.0)}, step_id=2)
+    with open(p2, "r+b") as f:
+        f.truncate(os.path.getsize(p2) // 3)
+    state, step_id = v1.restore_latest(_toy_state())
+    assert step_id == 1
 
 
 # ---------------------------------------------------------------------------
@@ -291,7 +304,7 @@ def test_rollback_across_epoch_boundary_still_checkpoints(tmp_path):
     import os
 
     names = sorted(os.listdir(ckpt_dir))
-    assert names == ["ckpt_0.npz", "ckpt_2.npz", "ckpt_4.npz"]
+    assert names == ["ckpt_0", "ckpt_2", "ckpt_4"]
 
 
 class _SigtermOnFetch:
@@ -425,16 +438,24 @@ def test_async_writer_matches_sync(tmp_path):
 
 
 def test_async_writer_latches_errors(tmp_path, monkeypatch):
+    """A worker-side write failure is latched, re-raised on the training
+    thread, and the in-flight transaction aborts (no torn published dir)."""
+    import os
+
+    from mpi4dl_tpu import checkpoint as ckpt_mod
+
     mgr = CheckpointManager(str(tmp_path))
     monkeypatch.setattr(
-        mgr, "save_arrays",
-        lambda arrays, step_id: (_ for _ in ()).throw(OSError("disk gone")),
+        ckpt_mod.ShardedSaveTxn, "add_shard",
+        lambda self, *a: (_ for _ in ()).throw(OSError("disk gone")),
     )
     w = AsyncCheckpointWriter(mgr)
     w.save({"w": jnp.ones((2,))}, 1)
     with pytest.raises(CheckpointWriteError):
         w.flush()
     w.close()
+    assert not os.path.exists(mgr.path_for(1))  # aborted, never published
+    assert not [f for f in os.listdir(tmp_path) if f.startswith(".tmp")]
 
 
 # ---------------------------------------------------------------------------
@@ -520,3 +541,243 @@ def test_fault_injectors_fire_once():
     assert inj.poison_loss(1, 1.0) == 1.0
     assert np.isnan(inj.poison_loss(2, 1.0))
     assert inj.poison_loss(2, 1.0) == 1.0  # single-shot
+
+
+# ---------------------------------------------------------------------------
+# Rollback decay (ISSUE 13 satellite): rare anomalies are forgiven, clusters
+# still fail fast
+# ---------------------------------------------------------------------------
+
+
+def test_guard_rollback_decay_forgives_spaced_anomalies():
+    g = AnomalyGuard(max_rollbacks=2, rollback_decay_steps=3)
+    for _round in range(6):  # far more lifetime anomalies than max_rollbacks
+        assert g.check(float("nan")) is not None
+        g.note_rollback()  # must never raise: decay keeps the count low
+        for _ in range(3):  # a clean stretch forgives one rollback
+            assert g.check(1.0) is None
+    assert g.rollbacks <= 2
+
+
+def test_guard_clustered_anomalies_still_fail_fast():
+    g = AnomalyGuard(max_rollbacks=2, rollback_decay_steps=3)
+    g.note_rollback()
+    assert g.check(1.0) is None  # one good step is not a clean stretch
+    g.note_rollback()
+    with pytest.raises(AnomalyError):
+        g.note_rollback()
+
+
+def test_guard_decay_disabled_keeps_lifetime_counter():
+    g = AnomalyGuard(max_rollbacks=1, rollback_decay_steps=0)
+    g.note_rollback()
+    for _ in range(100):
+        g.check(1.0)
+    with pytest.raises(AnomalyError):
+        g.note_rollback()
+
+
+def test_guard_anomaly_resets_good_streak():
+    g = AnomalyGuard(max_rollbacks=1, rollback_decay_steps=4)
+    g.note_rollback()
+    g.check(1.0)
+    g.check(1.0)
+    g.check(float("nan"))  # streak resets: 2+2 good steps must NOT decay
+    g.check(1.0)
+    g.check(1.0)
+    assert g.rollbacks == 1
+
+
+# ---------------------------------------------------------------------------
+# `checkpoint` RunLog record: save cost is observable (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_runlog_record(tmp_path):
+    runlog = RunLog(str(tmp_path / "run.jsonl"))
+    _run_toy(tmp_path, steps=2, epochs=2, ckpt_dir=tmp_path / "ck",
+             guard=AnomalyGuard(), runlog=runlog)
+    runlog.close()
+    recs = [r for r in read_runlog(str(tmp_path / "run.jsonl"))
+            if r["kind"] == "checkpoint"]
+    # baseline at 0 + epoch boundaries at 2 and 4
+    assert [r["gstep"] for r in recs] == [0, 2, 4]
+    for r in recs:
+        assert r["bytes"] > 0 and r["shards"] >= 1
+        assert r["gather_ms"] >= 0 and r["write_ms"] > 0
+        assert r["format"] == "sharded"
+        assert r["path"].endswith(f"ckpt_{r['gstep']}")
+
+
+# ---------------------------------------------------------------------------
+# Mesh-level faults (ISSUE 13): lost shard files
+# ---------------------------------------------------------------------------
+
+
+def test_lost_shard_files_fault_falls_back(tmp_path):
+    """lost_shard_files@3 deletes shard files from the step-4 boundary
+    checkpoint; a resume must reject it on the cheap stat pass and restore
+    the step-2 file — recovery costs one interval, not the run."""
+    ckpt_dir = tmp_path / "ck"
+    res = _run_toy(tmp_path, steps=2, epochs=2, ckpt_dir=ckpt_dir,
+                   faults=FaultInjector(FaultSpec("lost_shard_files", 3)),
+                   guard=AnomalyGuard())
+    assert res.final_step == 4
+    mgr = CheckpointManager(str(ckpt_dir))
+    state, step_id = mgr.restore_latest(_toy_state())
+    assert step_id == 2  # newest (4) lost its shards; fallback to 2
+
+
+def test_lose_shard_files_keeps_manifest(tmp_path):
+    from mpi4dl_tpu.resilience import lose_shard_files
+
+    mgr = CheckpointManager(str(tmp_path))
+    path = mgr.save({"a": jnp.ones((4,)), "b": jnp.ones((4,))}, 1)
+    lose_shard_files(path)
+    import os
+
+    assert os.path.exists(os.path.join(path, "manifest.json"))
+    from mpi4dl_tpu.checkpoint import CheckpointInvalid, cheap_validate
+
+    with pytest.raises(CheckpointInvalid, match="missing"):
+        cheap_validate(path)
+
+
+# ---------------------------------------------------------------------------
+# Async writer: sharded streaming under the host-byte budget (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+
+def test_async_writer_memory_bound(tmp_path, monkeypatch):
+    """Peak gathered-but-unwritten bytes during an async save stay inside
+    the budget — O(budget + largest shard), not O(full state) — even when
+    the disk is slow (the training thread blocks instead of buffering)."""
+    from mpi4dl_tpu.checkpoint import ShardedSaveTxn
+
+    orig = ShardedSaveTxn.add_shard
+
+    def slow_add(self, leaf_id, offset, arr):
+        time.sleep(0.01)  # force backpressure
+        return orig(self, leaf_id, offset, arr)
+
+    monkeypatch.setattr(ShardedSaveTxn, "add_shard", slow_add)
+    state = {f"l{i}": jnp.ones((1 << 16,), jnp.float32) for i in range(8)}
+    total = 8 * (1 << 18)
+    budget = 2 << 18  # two leaves
+    mgr = CheckpointManager(str(tmp_path))
+    with AsyncCheckpointWriter(mgr, max_pending_bytes=budget) as w:
+        path = w.save(state, 1)
+        w.flush()
+        assert w.peak_pending_bytes <= budget
+        assert w.peak_pending_bytes < total
+    arrays, step_id = load_arrays(path)
+    assert step_id == 1 and len(arrays) == 8
+    stats = mgr.last_save_stats
+    assert stats.bytes == total and stats.peak_pending_bytes <= budget
+
+
+def test_pending_bytes_budget_hatch(monkeypatch):
+    from mpi4dl_tpu.resilience.writer import (
+        DEFAULT_PENDING_BYTES,
+        pending_bytes_budget,
+    )
+
+    monkeypatch.delenv("MPI4DL_CKPT_HOST_BYTES", raising=False)
+    assert pending_bytes_budget() == DEFAULT_PENDING_BYTES
+    assert pending_bytes_budget(123) == 123
+    monkeypatch.setenv("MPI4DL_CKPT_HOST_BYTES", "4096")
+    assert pending_bytes_budget() == 4096
+
+
+# ---------------------------------------------------------------------------
+# Watchdog stall dumps carry memory stats + the last checkpoint record
+# (ISSUE 13 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_dump_memory_and_checkpoint_record():
+    out = io.StringIO()
+    ctx = {
+        "last": {"kind": "step", "gstep": 9},
+        "last_checkpoint": {"kind": "checkpoint", "gstep": 8, "bytes": 123},
+    }
+    wd = StepWatchdog(0.05, get_context=lambda: ctx, out=out)
+    with wd:
+        wd.arm("step 9")
+        time.sleep(0.4)
+        wd.disarm()
+    text = out.getvalue()
+    assert json.dumps({"kind": "step", "gstep": 9}) in text
+    assert "last_checkpoint runlog record" in text
+    assert json.dumps({"kind": "checkpoint", "gstep": 8, "bytes": 123}) in text
+    assert "memory:" in text and "host rss peak" in text
+
+
+# ---------------------------------------------------------------------------
+# Fault-spec parsing: mesh-level kinds
+# ---------------------------------------------------------------------------
+
+
+def test_parse_fault_mesh_kinds():
+    assert parse_fault("lost_shard_files@4") == FaultSpec(
+        "lost_shard_files", 4
+    )
+    spec = parse_fault("reshape@2:slice-method=horizontal,parts=2")
+    assert spec.kind == "reshape" and spec.step == 2
+    assert spec.opts == "slice-method=horizontal,parts=2" and spec.arg == 0.0
+    # numeric args still land in .arg (stall_data semantics unchanged)
+    assert parse_fault("stall_data@2:1.5") == FaultSpec("stall_data", 2, 1.5)
+    # only reshape takes text: a numeric typo elsewhere fails LOUDLY rather
+    # than silently running with the default arg
+    with pytest.raises(ValueError, match="numeric arg"):
+        parse_fault("stall_data@5:2,5")
+
+
+def test_reshape_fault_preempts_cleanly(tmp_path):
+    """In-loop, reshape IS a preemption: finish the step, checkpoint, exit
+    cleanly; the geometry change happens on the resume side (drill)."""
+    ckpt_dir = tmp_path / "ck"
+    res = _run_toy(
+        tmp_path, steps=4, ckpt_dir=ckpt_dir,
+        faults=FaultInjector(FaultSpec("reshape", 2, opts="parts=2")),
+    )
+    assert res.preempted and res.final_step == 3
+    _, step_id = CheckpointManager(str(ckpt_dir)).restore_latest(_toy_state())
+    assert step_id == 3
+
+
+# ---------------------------------------------------------------------------
+# Kill-and-resume exactness under ACTIVE hatches (ISSUE 13 satellite):
+# quantized collectives + stripe backward must not break the bit-identity
+# contract.  Runs in the resilience-drill CI job (-m slow).
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_sp_kill_and_resume_bit_identical_quant_stripe(tmp_path, devices8):
+    import os
+
+    from benchmarks.common import run
+
+    def argv(ck):
+        return [
+            "--image-size", "32", "--num-layers", "1", "--batch-size", "4",
+            "--steps-per-epoch", "4", "--quant", "int8",
+            "--checkpoint-dir", str(tmp_path / ck),
+        ]
+
+    os.environ["MPI4DL_STRIPE_BWD"] = "1"
+    try:
+        control = run("sp", "resnet", argv("ck_a"))
+        os.environ["MPI4DL_FAULT"] = "sigterm@2"
+        try:
+            killed = run("sp", "resnet", argv("ck_b"))
+        finally:
+            del os.environ["MPI4DL_FAULT"]
+        assert killed["preempted"] and killed["final_step"] == 3
+        resumed = run("sp", "resnet", argv("ck_b"))
+    finally:
+        del os.environ["MPI4DL_STRIPE_BWD"]
+    assert not resumed["preempted"] and resumed["final_step"] == 4
+    assert not resumed["elastic"]  # same resolved hatches = same layout
+    assert resumed["loss"] == control["loss"]  # bit-identical under hatches
