@@ -416,6 +416,12 @@ def run(family: str, model: str, argv=None) -> dict:
              "record to stderr (default: MPI4DL_WATCHDOG_SECS, else off; "
              "docs/resilience.md)",
     )
+    parser.add_argument(
+        "--watchdog-compile-secs", type=float, default=None,
+        help="watchdog budget for the FIRST step (the one that pays the "
+             "XLA compile; default: MPI4DL_WATCHDOG_COMPILE_SECS, else 10x "
+             "the step budget; docs/resilience.md)",
+    )
     args = parser.parse_args(argv)
     cfg = config_from_args(args)
     if cfg.verbose:
@@ -554,6 +560,7 @@ def run(family: str, model: str, argv=None) -> dict:
             guard=AnomalyGuard.from_env(),
             faults=FaultInjector.from_env(),
             watchdog_secs=watchdog_budget_from_env(args.watchdog_secs),
+            watchdog_compile_secs=args.watchdog_compile_secs,
         )
     finally:
         if args.profile_dir:

@@ -160,7 +160,7 @@ def _probe_family(args) -> dict:
     import jax
 
     from benchmarks.common import _ensure_devices, build_train
-    from mpi4dl_tpu.config import ParallelConfig
+    from mpi4dl_tpu.config import ParallelConfig, _spatial_until_arg
     from mpi4dl_tpu.mesh import MeshSpec, build_mesh
 
     schedules = (
@@ -188,6 +188,10 @@ def _probe_family(args) -> dict:
             num_filters=args.num_filters,
             num_classes=args.num_classes,
             quant_collectives=args.quant,
+            spatial_until=_spatial_until_arg(
+                getattr(args, "spatial_until", None)
+            ),
+            slice_method=getattr(args, "slice_method", "square"),
         )
         spec = (
             MeshSpec.from_config(cfg)
@@ -535,6 +539,15 @@ def main(argv=None) -> int:
     p.add_argument("--spatial-size", type=int, default=1)
     p.add_argument("--num-spatial-parts", type=int, default=2)
     p.add_argument("--num-classes", type=int, default=10)
+    p.add_argument("--spatial-until", default=None, metavar="N|auto",
+                   help="SP->LP junction placement for the probed engines "
+                        "(an explicit cell index or 'auto' — the flag the "
+                        "supervisor's degrade planner probes through; "
+                        "family mode only)")
+    p.add_argument("--slice-method", default="square",
+                   choices=["square", "vertical", "horizontal"],
+                   help="spatial slicing of the probed engines (the probe "
+                        "must build the SAME tile grid the relaunch would)")
     p.add_argument("--quant", default="off", metavar="SPEC",
                    help="quantized-collective policy for the probed engines "
                         "(off | int8|fp8|int4 | per-class spec; "

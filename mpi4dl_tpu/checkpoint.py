@@ -76,6 +76,15 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
+from mpi4dl_tpu.utils.retry import retry_io
+
+# Bounded-retry budget for checkpoint-file I/O (ISSUE 15 satellite): NFS and
+# GCS-fuse checkpoint dirs throw transient OSErrors routinely, so shard-file
+# writes and manifest reads retry with backoff (the same retry_io discipline
+# the data pipeline uses) before failing with the ORIGINAL exception.
+_IO_RETRIES = 2
+_IO_BACKOFF = 0.05
+
 _CKPT_RE = re.compile(r"^ckpt_(\d+)\.npz$")
 _CKPT_DIR_RE = re.compile(r"^ckpt_(\d+)$")
 
@@ -415,6 +424,15 @@ def state_shard_plan(state: Any) -> List[Tuple[int, dict, List[Tuple[Tuple[int, 
     return plan
 
 
+def _write_shard_file(path: str, view: np.ndarray) -> None:
+    """Write + fsync one shard payload (indirection point for the transient-
+    I/O retry tests; idempotent, so ``retry_io`` may call it repeatedly)."""
+    with open(path, "wb") as f:
+        f.write(memoryview(view))
+        f.flush()
+        os.fsync(f.fileno())
+
+
 class ShardedSaveTxn:
     """One in-flight sharded checkpoint write: shard files land fsync'd in a
     hidden tmp directory; ``commit`` writes the manifest, fsyncs, and
@@ -444,15 +462,15 @@ class ShardedSaveTxn:
     def add_shard(self, leaf_id: int, offset: Tuple[int, ...],
                   arr: np.ndarray) -> int:
         """Write one gathered shard durably; returns bytes written.  Any
-        thread."""
+        thread.  Transient write errors retry with backoff (each retry
+        reopens and rewrites the whole shard file — partial writes never
+        survive an attempt)."""
         t0 = time.perf_counter()
         entry = self._leaves[leaf_id]
         fname = f"leaf{leaf_id:05d}_s{len(entry['shards']):03d}.bin"
         view = _byte_view(arr)
-        with open(os.path.join(self._tmp, fname), "wb") as f:
-            f.write(memoryview(view))
-            f.flush()
-            os.fsync(f.fileno())
+        retry_io(lambda: _write_shard_file(os.path.join(self._tmp, fname), view),
+                 retries=_IO_RETRIES, backoff=_IO_BACKOFF)
         entry["shards"].append({
             "file": fname,
             "offset": [int(o) for o in offset],
@@ -539,11 +557,24 @@ def checkpoint_format(path: str) -> str:
     return "sharded" if os.path.isdir(path) else "npz"
 
 
+def _read_text(path: str) -> str:
+    """Read one small text file fully (indirection point for the transient-
+    I/O retry tests; the retry wraps the CALL, not this helper)."""
+    with open(path, "r", encoding="utf-8") as f:
+        return f.read()
+
+
 def read_sharded_manifest(path: str) -> dict:
     mpath = os.path.join(path, SHARD_MANIFEST)
     try:
-        with open(mpath, "r", encoding="utf-8") as f:
-            return json.load(f)
+        # Transient OSErrors (NFS blip, stale handle) retry with backoff; a
+        # manifest that READS but does not parse is torn, not transient,
+        # and a MISSING manifest is deterministic (exactly what the torn-
+        # checkpoint fallback walk probes) — neither is worth a retry.
+        raw = retry_io(lambda: _read_text(mpath),
+                       retries=_IO_RETRIES, backoff=_IO_BACKOFF,
+                       no_retry=(FileNotFoundError,))
+        return json.loads(raw)
     except OSError as e:
         raise CheckpointInvalid(f"{path}: no readable manifest ({e!r})") from e
     except ValueError as e:
@@ -674,7 +705,14 @@ def load_sharded_arrays(path: str, manifest: Optional[dict] = None
         out = np.empty(shape, dtype)
         for sh in leaf["shards"]:
             try:
-                raw = _read_shard_bytes(os.path.join(path, sh["file"]))
+                raw = retry_io(
+                    lambda f=os.path.join(path, sh["file"]):
+                        _read_shard_bytes(f),
+                    retries=_IO_RETRIES, backoff=_IO_BACKOFF,
+                    # a vanished shard (the lost_shard_files drill) is
+                    # deterministic — fall back NOW, not after backoff
+                    no_retry=(FileNotFoundError,),
+                )
             except OSError as e:  # vanished/unreadable shard = torn ckpt
                 raise CheckpointInvalid(
                     f"{path}: shard file {sh['file']} unreadable ({e!r})"

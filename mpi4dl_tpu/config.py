@@ -107,9 +107,11 @@ HATCHES: Dict[str, Hatch] = {
         Hatch("MPI4DL_FAULT", "<unset>",
               "Deterministic fault injection: `<kind>@<step>[:arg]` with "
               "kind in nan_loss|nan_batch|raise|sigterm|corrupt_ckpt|"
-              "lost_shard_files|reshape|stall_data — drives "
+              "lost_shard_files|reshape|stall_data|oom_compile|oom_step|"
+              "mesh_shrunk|slow_step|io_error — drives "
               "tests/test_resilience.py and the CI kill-and-resume + "
-              "resilience-drill jobs (docs/resilience.md)."),
+              "resilience-drill + supervisor-drill jobs "
+              "(docs/resilience.md)."),
         Hatch("MPI4DL_CKPT_HOST_BYTES", str(1 << 30),
               "Byte budget for gathered-but-unwritten checkpoint shards in "
               "the async writer (sharded format): the training thread "
@@ -121,6 +123,38 @@ HATCHES: Dict[str, Hatch] = {
               "(batch fetch + device step) exceeding it dumps live Python "
               "stacks + the last RunLog record to stderr "
               "(`--watchdog-secs` overrides)."),
+        Hatch("MPI4DL_WATCHDOG_COMPILE_SECS", "10x step budget",
+              "Watchdog budget for the FIRST step of a process (the one "
+              "that pays the multi-minute XLA compile) — disarms after the "
+              "first completed step, so realistic step budgets no longer "
+              "false-trigger stall dumps during compile "
+              "(`--watchdog-compile-secs` overrides; docs/resilience.md)."),
+        Hatch("MPI4DL_WATCHDOG_ESCALATE", "0",
+              "Watchdog escalation count (0 = dump forever): once one armed "
+              "step has produced this many stall dumps, the watchdog writes "
+              "a typed `hang` crash marker and exits the leg (status 82) so "
+              "the supervisor can classify and relaunch instead of hanging "
+              "until the scheduler kills it (docs/resilience.md)."),
+        Hatch("MPI4DL_SUPERVISE_MAX_ATTEMPTS", "6",
+              "Elastic supervisor: total training-leg launches before "
+              "giving up (per-failure-class bounds apply on top — "
+              "docs/resilience.md, policy matrix)."),
+        Hatch("MPI4DL_SUPERVISE_BACKOFF", "1.0",
+              "Elastic supervisor: base seconds of the exponential "
+              "retry backoff (doubles per same-class recurrence, "
+              "jittered +-25%)."),
+        Hatch("MPI4DL_SUPERVISE_BACKOFF_CAP", "30",
+              "Elastic supervisor: backoff ceiling in seconds (the "
+              "exponential curve clamps here before jitter)."),
+        Hatch("MPI4DL_QUARANTINE_STEPS", "<unset>",
+              "Comma-list of global steps the supervised loop SKIPS "
+              "outright (fetch nothing, train nothing, `quarantine` RunLog "
+              "record) — the supervisor's poison-batch exclusion after a "
+              "nan_cluster leg (docs/resilience.md)."),
+        Hatch("MPI4DL_CRASH_MARKER", "<unset>",
+              "Internal: where a supervised leg writes its structured "
+              "crash marker (phase, step, error) on the way down — the "
+              "supervisor points it at a per-attempt file.", internal=True),
         Hatch("MPI4DL_NO_GUARD", "0",
               "1 = disable the anomaly guard (per-step finite-loss check "
               "with rollback to the last good checkpoint and poison-batch "

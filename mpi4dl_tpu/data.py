@@ -219,20 +219,14 @@ def fetch_batch_with_retry(dataset, idx: int, batch_size: int, *,
     image-folder path), then fail-fast re-raising the ORIGINAL exception —
     the ISSUE-3 replacement for the producer's single-shot raise.  Non-I/O
     errors (bad shapes, logic bugs) propagate immediately: retrying those
-    only delays the crash."""
-    delay = backoff
-    first: Optional[OSError] = None
-    for remaining in range(retries, -1, -1):
-        try:
-            return dataset.batch(idx, batch_size)
-        except OSError as e:
-            if first is None:
-                first = e
-            if remaining == 0:
-                raise first
-            _sleep(delay)
-            delay *= 2.0
-    raise AssertionError("unreachable")  # loop always returns or raises
+    only delays the crash.  The retry discipline itself lives in
+    :func:`mpi4dl_tpu.utils.retry_io` (shared with the checkpoint layer)."""
+    from mpi4dl_tpu.utils import retry_io
+
+    return retry_io(
+        lambda: dataset.batch(idx, batch_size),
+        retries=retries, backoff=backoff, _sleep=_sleep,
+    )
 
 
 def prefetch_batches(

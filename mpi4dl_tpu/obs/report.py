@@ -94,7 +94,8 @@ def render_run(path: str) -> str:
 
     # -- resilience events (docs/resilience.md) ----------------------------
     events = [r for r in records
-              if r.get("kind") in ("anomaly", "recovery", "preempt")]
+              if r.get("kind") in ("anomaly", "recovery", "preempt",
+                                   "quarantine")]
     if events:
         parts = []
         for r in events:
@@ -106,6 +107,39 @@ def render_run(path: str) -> str:
                 extra = f" (resumed from {r.get('resumed_from')})"
             parts.append(f"{r['kind']}@{at}{extra}")
         lines.append("resilience events: " + "; ".join(parts))
+
+    # -- supervisor incident timeline (ISSUE 15) ---------------------------
+    incidents = [r for r in records if r.get("kind") == "supervisor"]
+    if incidents:
+        lines.append(f"supervisor incidents: {len(incidents)}")
+        for r in incidents:
+            bits = [f"  attempt {r.get('attempt')}: "
+                    f"{r.get('failure_class')} -> {r.get('policy')}"]
+            delta = r.get("config_delta")
+            if delta:
+                bits.append("delta " + ",".join(
+                    f"{k}={v}" for k, v in delta.items()
+                ))
+            probe = r.get("probe") or {}
+            if probe.get("probe_peak_gb") is not None:
+                gauge = probe.get("budget_gb")
+                bits.append(
+                    f"probed {probe['probe_peak_gb']} GB"
+                    + (f" <= {gauge} GB" if gauge is not None else "")
+                )
+            if r.get("backoff_s") is not None:
+                bits.append(f"backoff {r['backoff_s']} s")
+            if r.get("quarantined"):
+                bits.append(f"quarantined {r['quarantined']}")
+            lines.append("  ".join(bits))
+    sup_sum = _first(records, "supervisor_summary")
+    if sup_sum is not None:
+        lines.append(
+            f"supervisor: {'completed' if sup_sum.get('ok') else 'FAILED'} "
+            f"after {sup_sum.get('attempts')} leg(s), "
+            f"{sup_sum.get('incidents')} incident(s)"
+            + (f" — {sup_sum.get('reason')}" if sup_sum.get("reason") else "")
+        )
 
     # -- checkpoint ledger (ISSUE 13: save cost + elastic restores) --------
     ckpts = [r for r in records if r.get("kind") == "checkpoint"]

@@ -24,7 +24,13 @@ a multi-day pathology run.  This package turns the existing pieces
   memory stats before a hang dies silently.
 - :mod:`~mpi4dl_tpu.resilience.drill` — the mesh-fault drill harness
   (``python -m mpi4dl_tpu.resilience drill``): scripted disasters with
-  typed per-scenario verdicts.
+  typed per-scenario verdicts; ``--supervisor`` drills the supervisor's
+  whole control plane.
+- :mod:`~mpi4dl_tpu.resilience.supervisor` — the elastic supervisor
+  (ISSUE 15): legs as subprocesses, typed failure taxonomy, per-class
+  retry/backoff, poison-batch quarantine, degrade-and-continue.
+- :mod:`~mpi4dl_tpu.resilience.planner` — the degradation ladder + the
+  compile-only feasibility probe the supervisor re-plans with.
 
 Event schema, fault kinds, manifest format, recovery semantics:
 docs/resilience.md.
@@ -35,9 +41,12 @@ from __future__ import annotations
 from mpi4dl_tpu.resilience.drill import (
     DrillVerdict,
     Scenario,
+    SupervisorScenario,
     default_scenarios,
     run_drills,
     run_scenario,
+    run_supervisor_drills,
+    supervisor_scenarios,
 )
 from mpi4dl_tpu.resilience.faults import (
     CKPT_FAULT_KINDS,
@@ -45,10 +54,31 @@ from mpi4dl_tpu.resilience.faults import (
     FaultInjected,
     FaultInjector,
     FaultSpec,
+    MeshShrunk,
     corrupt_file,
     fault_from_env,
     lose_shard_files,
     parse_fault,
+    synthetic_oom,
+)
+from mpi4dl_tpu.resilience.planner import (
+    Plan,
+    compile_probe,
+    degrade_candidates,
+    plan_degrade,
+)
+from mpi4dl_tpu.resilience.supervisor import (
+    FAILURE_CLASSES,
+    POLICIES,
+    Classification,
+    LegOutcome,
+    Policy,
+    Supervisor,
+    SupervisorResult,
+    backoff_delay,
+    classify_failure,
+    read_crash_marker,
+    write_crash_marker,
 )
 from mpi4dl_tpu.resilience.guard import AnomalyError, AnomalyGuard, global_norm
 from mpi4dl_tpu.resilience.loop import LoopResult, run_supervised
@@ -62,28 +92,48 @@ from mpi4dl_tpu.resilience.writer import AsyncCheckpointWriter, CheckpointWriteE
 
 __all__ = [
     "CKPT_FAULT_KINDS",
+    "FAILURE_CLASSES",
     "FAULT_KINDS",
+    "POLICIES",
     "AnomalyError",
     "AnomalyGuard",
     "AsyncCheckpointWriter",
     "CheckpointWriteError",
+    "Classification",
     "DrillVerdict",
     "FaultInjected",
     "FaultInjector",
     "FaultSpec",
+    "LegOutcome",
     "LoopResult",
+    "MeshShrunk",
+    "Plan",
+    "Policy",
     "PreemptionHandler",
     "Scenario",
     "StepWatchdog",
+    "Supervisor",
+    "SupervisorResult",
+    "SupervisorScenario",
+    "backoff_delay",
+    "classify_failure",
+    "compile_probe",
     "corrupt_file",
     "default_scenarios",
+    "degrade_candidates",
     "dump_stacks",
     "fault_from_env",
     "global_norm",
     "lose_shard_files",
     "parse_fault",
+    "plan_degrade",
+    "read_crash_marker",
     "run_drills",
     "run_scenario",
     "run_supervised",
+    "run_supervisor_drills",
+    "supervisor_scenarios",
+    "synthetic_oom",
     "watchdog_budget_from_env",
+    "write_crash_marker",
 ]

@@ -39,6 +39,18 @@ Each scenario emits one ``drill`` RunLog record with a typed verdict:
 with the evidence — no silent fresh-starts, no untyped failures.  This is
 the supervised-loop drill machinery ROADMAP item 4's serving loop will
 reuse (watchdog → SLO breach, preemption → drain + requeue).
+
+Supervisor drills (ISSUE 15, ``--supervisor``): scenarios that scripted-
+disaster the SUPERVISOR instead of a single leg — the fault is injected
+into the first leg only, and the judge checks the whole control plane:
+the typed classification, the policy (degrade vs retry vs quarantine), the
+feasibility-probed config delta, the elastic resume, and the final loss
+against a control run at the supervisor's final geometry
+(:func:`supervisor_scenarios` / :func:`run_supervisor_scenario`).
+Additional failure kinds there: ``misclassified`` (wrong taxonomy class),
+``wrong_policy`` (unexpected policy, unprobed degrade, or a geometry
+change where none was allowed), ``false_positive`` (incidents on a clean
+run).
 """
 
 from __future__ import annotations
@@ -384,3 +396,245 @@ def bench_runner(family: str = "sp", model: str = "resnet",
                 os.environ["MPI4DL_FAULT"] = prev
 
     return runner
+
+
+# ---------------------------------------------------------------------------
+# Supervisor-level drills (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+
+# Same small geometry the single-leg drills use: 2-step epochs x 2, so the
+# boundary checkpoints land at steps 0/2/4 and a fault at step 2 has a
+# fresh checkpoint behind it.
+_SUP_BASE: Dict[str, Any] = {
+    "image-size": 32, "num-layers": 1, "batch-size": 4,
+    "steps-per-epoch": 2, "num-epochs": 2,
+}
+
+# The acceptance geometry: SP(2x2)xPP(2) at parts=4 — the config the
+# oom drills degrade OUT of (the planner's halve_parts rung is the first
+# elastic move there; junction re-placement is excluded for sp_pipeline
+# states because it re-packs leaf shapes).
+_SUP_OOM_GEO: Dict[str, Any] = {
+    "split-size": 2, "parts": 4, "slice-method": "square",
+    "num-spatial-parts": "4",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class SupervisorScenario:
+    """One scripted disaster for the SUPERVISOR: the fault goes into leg 1
+    only; the judge checks classification, policy, config delta, elastic
+    resume, and the final loss against a control at the supervisor's final
+    geometry."""
+
+    name: str
+    fault: str  # empty = clean run (the no-false-positive scenario)
+    expect: str  # clean | exact | close
+    expect_class: Optional[str] = None
+    expect_policy: Optional[str] = None
+    # degrade scenarios must change geometry (and be probed + elastic);
+    # retry scenarios must NOT change geometry.
+    expect_delta: bool = False
+    overrides: Mapping[str, Any] = dataclasses.field(default_factory=dict)
+    rtol: float = 0.05
+    probe: bool = False  # run the real compile-only feasibility probe
+
+
+def supervisor_scenarios() -> List[SupervisorScenario]:
+    """The supervisor drill matrix (CI ``supervisor-drill`` lane)."""
+    return [
+        SupervisorScenario(
+            "sup_clean", fault="", expect="clean",
+        ),
+        SupervisorScenario(
+            "sup_oom_degrade", fault="oom_compile@0", expect="close",
+            expect_class="oom_compile", expect_policy="degrade",
+            expect_delta=True, overrides=dict(_SUP_OOM_GEO), probe=True,
+        ),
+        SupervisorScenario(
+            "sup_oom_step_degrade", fault="oom_step@2", expect="close",
+            expect_class="oom_step", expect_policy="degrade",
+            expect_delta=True, overrides=dict(_SUP_OOM_GEO), probe=True,
+        ),
+        SupervisorScenario(
+            "sup_transient_io", fault="io_error@2", expect="exact",
+            expect_class="transient_io", expect_policy="retry",
+            expect_delta=False,
+        ),
+    ]
+
+
+def run_supervisor_scenario(
+    sc: SupervisorScenario, workdir: str,
+    family: str = "sp", model: str = "resnet",
+    log: Callable[[str], None] = lambda s: None,
+    launcher_factory=None,
+) -> DrillVerdict:
+    """Execute one supervisor scenario and judge the whole control plane.
+
+    ``launcher_factory(family, model, workdir)`` is injectable for tests;
+    the default launches real subprocess legs through the benchmark entry
+    point (each attempt a fresh process — which also sidesteps the jax-0.4.x
+    same-program compile-cache hazard the single-leg drills document)."""
+    from mpi4dl_tpu.obs import RunLog
+    from mpi4dl_tpu.resilience.planner import compile_probe
+    from mpi4dl_tpu.resilience.supervisor import (
+        Supervisor,
+        subprocess_leg_launcher,
+    )
+
+    wd = os.path.join(workdir, sc.name)
+    shutil.rmtree(wd, ignore_errors=True)
+    os.makedirs(wd, exist_ok=True)
+    details: Dict[str, Any] = {"fault": sc.fault, "expect": sc.expect}
+    flags: Dict[str, Any] = {**_SUP_BASE, **sc.overrides,
+                             "checkpoint-dir": os.path.join(wd, "ck_sup")}
+    factory = (
+        launcher_factory if launcher_factory is not None
+        else subprocess_leg_launcher
+    )
+    sup_runlog = RunLog(os.path.join(wd, "supervisor.jsonl"))
+    try:
+        sup = Supervisor(
+            family, model, flags,
+            workdir=os.path.join(wd, "legs"),
+            runlog=sup_runlog,
+            launch=factory(family, model, os.path.join(wd, "legs")),
+            probe=compile_probe(family, model) if sc.probe else None,
+            fault=sc.fault,
+            log=log,
+        )
+        res = sup.run()
+    except (Exception, SystemExit) as e:
+        return DrillVerdict(sc.name, False, "leg_error",
+                            {**details, "leg": "supervisor",
+                             "error": repr(e)})
+    finally:
+        sup_runlog.close()
+    details["attempts"] = res.attempts
+    details["incidents"] = res.incidents
+    details["final_flags"] = dict(res.flags or {})
+    if not res.ok or not res.final:
+        return DrillVerdict(sc.name, False, "not_recovered",
+                            {**details, "reason": res.reason
+                             or "supervisor gave up"})
+
+    if sc.expect == "clean":
+        if res.incidents:
+            return DrillVerdict(
+                sc.name, False, "false_positive",
+                {**details,
+                 "reason": f"clean run produced {len(res.incidents)} "
+                           "incident record(s)"},
+            )
+        return DrillVerdict(sc.name, True, "verified_recovery", details)
+
+    if not res.incidents:
+        return DrillVerdict(
+            sc.name, False, "fault_not_honored",
+            {**details, "reason": "fault leg produced no incident"},
+        )
+    first = res.incidents[0]
+    if sc.expect_class and first.get("failure_class") != sc.expect_class:
+        return DrillVerdict(
+            sc.name, False, "misclassified",
+            {**details,
+             "reason": f"classified {first.get('failure_class')!r}, "
+                       f"expected {sc.expect_class!r}"},
+        )
+    if sc.expect_policy and first.get("policy") != sc.expect_policy:
+        return DrillVerdict(
+            sc.name, False, "wrong_policy",
+            {**details,
+             "reason": f"policy {first.get('policy')!r}, expected "
+                       f"{sc.expect_policy!r}"},
+        )
+    changed = dict(res.flags or {}) != flags or bool(res.env)
+    if sc.expect_delta:
+        if not first.get("config_delta"):
+            return DrillVerdict(
+                sc.name, False, "wrong_policy",
+                {**details, "reason": "degrade incident carries no "
+                                      "config delta"},
+            )
+        if sc.probe and "probe_peak_gb" not in (first.get("probe") or {}):
+            return DrillVerdict(
+                sc.name, False, "wrong_policy",
+                {**details, "reason": "degraded config was not "
+                                      "feasibility-probed"},
+            )
+        if not res.final.get("elastic"):
+            return DrillVerdict(
+                sc.name, False, "fresh_start",
+                {**details,
+                 "reason": "degraded relaunch did not elastic-restore "
+                           "(final leg reports elastic=false)"},
+            )
+    elif changed:
+        return DrillVerdict(
+            sc.name, False, "wrong_policy",
+            {**details, "reason": "geometry changed on a retry-class "
+                                  "failure"},
+        )
+
+    # Control: an uninterrupted run at the supervisor's FINAL geometry.
+    control_flags = dict(res.flags or flags)
+    control_flags["checkpoint-dir"] = os.path.join(wd, "ck_control")
+    log(f"[{sc.name}] control leg at final geometry...")
+    control_out = factory(family, model, os.path.join(wd, "control"))(
+        control_flags, dict(res.env), 1,
+    )
+    if control_out.rc != 0 or not control_out.result:
+        return DrillVerdict(sc.name, False, "leg_error",
+                            {**details, "leg": "control",
+                             "error": f"rc={control_out.rc}"})
+    control_loss = control_out.result.get("loss")
+    loss = res.final.get("loss")
+    details["control_loss"], details["final_loss"] = control_loss, loss
+    if loss is None or not math.isfinite(float(loss)):
+        return DrillVerdict(sc.name, False, "not_recovered",
+                            {**details, "reason": "non-finite final loss"})
+    if sc.expect == "exact" and float(loss) != float(control_loss):
+        return DrillVerdict(
+            sc.name, False, "drift",
+            {**details,
+             "reason": f"final loss {loss!r} != control {control_loss!r} "
+                       "(bit-identity promised)"},
+        )
+    if sc.expect == "close" and not _close(float(loss),
+                                           float(control_loss), sc.rtol):
+        return DrillVerdict(
+            sc.name, False, "drift",
+            {**details,
+             "reason": f"final loss {loss!r} not within rtol={sc.rtol} "
+                       f"of control {control_loss!r}"},
+        )
+    return DrillVerdict(sc.name, True, "verified_recovery", details)
+
+
+def run_supervisor_drills(
+    scenarios: Sequence[SupervisorScenario], workdir: str,
+    family: str = "sp", model: str = "resnet", runlog=None,
+    log: Callable[[str], None] = lambda s: None,
+    launcher_factory=None,
+) -> List[DrillVerdict]:
+    """Run the supervisor scenario matrix; one ``drill`` record per verdict
+    plus a ``drill_summary`` (same record vocabulary as the single-leg
+    matrix, so ``obs report`` renders both)."""
+    verdicts = []
+    for sc in scenarios:
+        v = run_supervisor_scenario(sc, workdir, family, model, log=log,
+                                    launcher_factory=launcher_factory)
+        verdicts.append(v)
+        log(f"[{sc.name}] {'PASS' if v.passed else 'FAIL'} ({v.kind})")
+        if runlog is not None:
+            runlog.write("drill", **v.record())
+    if runlog is not None:
+        runlog.write(
+            "drill_summary",
+            total=len(verdicts),
+            passed=sum(v.passed for v in verdicts),
+            failed=[v.scenario for v in verdicts if not v.passed],
+        )
+    return verdicts

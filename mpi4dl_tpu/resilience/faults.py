@@ -31,6 +31,23 @@ by-hand run.  Kinds:
                      treats it as a preemption
 ``stall_data``       the data producer sleeps ``arg`` seconds (default 2.0)
                      before batch k (watchdog path)
+``oom_compile``      raise a synthetic ``RESOURCE_EXHAUSTED`` XlaRuntimeError
+                     on the process's FIRST step once the step count reaches
+                     k — the phase where a compile-time OOM actually lands
+                     (XLA compiles lazily inside the first step call), so
+                     the supervisor classifies it ``oom_compile``
+``oom_step``         raise the same synthetic ``RESOURCE_EXHAUSTED`` error
+                     before step k (any step — a mid-run allocator OOM)
+``mesh_shrunk``      raise :class:`MeshShrunk` before step k; ``arg`` is a
+                     free-form spec (e.g. ``devices=4``) naming the
+                     surviving device set the planner must re-plan within
+``slow_step``        the training thread sleeps ``arg`` seconds (default
+                     2.0) inside the armed watchdog window before step k —
+                     the straggler the watchdog must ESCALATE on, not just
+                     dump (``MPI4DL_WATCHDOG_ESCALATE``)
+``io_error``         raise ``OSError`` before step k (the transient-I/O
+                     class: the supervisor retries with backoff, no
+                     geometry change)
 ===================  ========================================================
 
 Every injector fires at most once per process — deterministic single-shot
@@ -42,19 +59,55 @@ from __future__ import annotations
 import dataclasses
 import os
 import signal
+import time
 from typing import Any, Optional
 
 FAULT_KINDS = (
     "nan_loss", "nan_batch", "raise", "sigterm", "corrupt_ckpt",
     "lost_shard_files", "reshape", "stall_data",
+    "oom_compile", "oom_step", "mesh_shrunk", "slow_step", "io_error",
 )
 
 # Kinds whose effect is applied to the just-written checkpoint (after_save).
 CKPT_FAULT_KINDS = ("corrupt_ckpt", "lost_shard_files")
 
+# Kinds whose ``:arg`` is free text, not a number.
+_TEXT_ARG_KINDS = ("reshape", "mesh_shrunk")
+
 
 class FaultInjected(RuntimeError):
     """The injected crash for ``MPI4DL_FAULT=raise@<step>``."""
+
+
+class MeshShrunk(RuntimeError):
+    """The device set shrank under the run (``MPI4DL_FAULT=mesh_shrunk@k``,
+    or — on real hardware — a slice losing chips).  ``spec`` is the
+    free-form surviving-geometry description (e.g. ``devices=4``) the
+    supervisor's planner re-plans within."""
+
+    def __init__(self, spec: str = ""):
+        super().__init__(
+            f"mesh shrank under the run ({spec or 'no surviving spec'})"
+        )
+        self.spec = spec
+
+
+def synthetic_oom(kind: str, gstep: int) -> BaseException:
+    """A ``RESOURCE_EXHAUSTED`` error of the REAL XlaRuntimeError type where
+    this jax exposes it (so ``except XlaRuntimeError`` handlers and the
+    supervisor's classifier see exactly what a device OOM raises), falling
+    back to RuntimeError with the same message."""
+    msg = (
+        f"RESOURCE_EXHAUSTED: injected {kind} at step {gstep}: Out of "
+        "memory while trying to allocate synthetic fault payload "
+        "(MPI4DL_FAULT)"
+    )
+    try:
+        from jax._src.lib import xla_client
+
+        return xla_client.XlaRuntimeError(msg)
+    except Exception:  # noqa: BLE001 — jax layout drift: message still keys
+        return RuntimeError(msg)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -80,7 +133,7 @@ def parse_fault(text: Optional[str]) -> Optional[FaultSpec]:
         )
     num, opts = 0.0, ""
     if arg:
-        if kind == "reshape":  # the only kind with a free-text arg
+        if kind in _TEXT_ARG_KINDS:  # free-text arg (geometry specs)
             opts = arg
         else:
             try:
@@ -148,6 +201,7 @@ class FaultInjector:
     def __init__(self, spec: Optional[FaultSpec] = None):
         self.spec = spec
         self.fired = False
+        self._steps_seen = 0  # before_step calls — first call = first step
 
     @classmethod
     def from_env(cls) -> "FaultInjector":
@@ -167,9 +221,31 @@ class FaultInjector:
         """Crash/preemption faults, delivered before the step runs.  A
         ``reshape`` fault is a preemption here — the geometry change it
         declares happens at RESUME time (the drill runner applies
-        ``spec.opts`` to the resume leg's flags)."""
+        ``spec.opts`` to the resume leg's flags).  ``oom_compile`` fires on
+        the process's FIRST step once ``gstep >= k`` (at-or-after, so a
+        resumed leg starting past k still exercises the compile phase);
+        every other kind fires exactly at step k."""
+        self._steps_seen += 1
+        if (
+            self.spec is not None and not self.fired
+            and self.spec.kind == "oom_compile"
+            and self._steps_seen == 1 and gstep >= self.spec.step
+        ):
+            self.fired = True
+            raise synthetic_oom("oom_compile", gstep)
         if self._fire("raise", gstep):
             raise FaultInjected(f"injected crash before step {gstep}")
+        if self._fire("oom_step", gstep):
+            raise synthetic_oom("oom_step", gstep)
+        if self._fire("mesh_shrunk", gstep):
+            raise MeshShrunk(self.spec.opts)
+        if self._fire("io_error", gstep):
+            raise OSError(
+                f"injected transient I/O failure before step {gstep} "
+                "(MPI4DL_FAULT=io_error)"
+            )
+        if self._fire("slow_step", gstep):
+            time.sleep(self.spec.arg or 2.0)
         if self._fire("sigterm", gstep) or self._fire("reshape", gstep):
             os.kill(os.getpid(), signal.SIGTERM)
 

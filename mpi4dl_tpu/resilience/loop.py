@@ -25,6 +25,17 @@ Event records written to the RunLog (see docs/resilience.md):
 - ``checkpoint`` — one completed save: gather/write ms, bytes, shard
   count, peak pending host bytes (ISSUE 13: checkpoint stalls become
   observable instead of mystery gaps in the step stream)
+- ``quarantine`` — a step skipped by the supervisor's poison-batch
+  exclusion (``MPI4DL_QUARANTINE_STEPS``, ISSUE 15)
+
+Supervision plumbing (ISSUE 15): when the ``MPI4DL_CRASH_MARKER`` hatch
+points at a file, any exception escaping the loop first writes a structured
+crash marker — the phase it died in (``compile`` covers the process's first
+step, the one that pays the XLA compile), the global step, and the error —
+so the supervisor can classify the failure without parsing tracebacks.  The
+watchdog gains the compile-grace budget for the first step and, under
+``MPI4DL_WATCHDOG_ESCALATE``, escalates a persistent straggler into a typed
+``hang`` exit instead of dumping forever.
 """
 
 from __future__ import annotations
@@ -32,6 +43,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import math
+import os
 from typing import Any, Callable, Dict, Optional
 
 from mpi4dl_tpu.checkpoint import CheckpointManager, arrays_to_state, state_to_arrays
@@ -39,7 +51,17 @@ from mpi4dl_tpu.data import prefetch_batches
 from mpi4dl_tpu.resilience.faults import CKPT_FAULT_KINDS, FaultInjector
 from mpi4dl_tpu.resilience.guard import AnomalyError, AnomalyGuard
 from mpi4dl_tpu.resilience.preempt import PreemptionHandler
-from mpi4dl_tpu.resilience.watchdog import StepWatchdog
+from mpi4dl_tpu.resilience.supervisor import (
+    crash_marker_path,
+    quarantine_steps_from_env,
+    write_crash_marker,
+)
+from mpi4dl_tpu.resilience.watchdog import (
+    HANG_EXIT_CODE,
+    StepWatchdog,
+    watchdog_compile_budget_from_env,
+    watchdog_escalation_from_env,
+)
 from mpi4dl_tpu.resilience.writer import AsyncCheckpointWriter
 from mpi4dl_tpu.utils import Timer
 
@@ -75,6 +97,7 @@ def run_supervised(
     guard: Optional[AnomalyGuard] = None,
     faults: Optional[FaultInjector] = None,
     watchdog_secs: float = 0.0,
+    watchdog_compile_secs: Optional[float] = None,
     handle_signals: bool = True,
     retries: int = 2,
     retry_backoff: float = 0.05,
@@ -103,6 +126,12 @@ def run_supervised(
     anomalies = 0
     preempted = False
     steps_run = 0
+    # Supervisor plumbing (ISSUE 15): where to leave structured last words,
+    # which steps are quarantined, which phase the loop is in (the crash
+    # marker's phase field — "compile" is the process's first step).
+    marker_path = crash_marker_path()
+    quarantine = quarantine_steps_from_env()
+    phase = "init"
 
     def _ckpt_record(stats) -> None:
         """Emit the ``checkpoint`` RunLog record (worker thread for async
@@ -116,8 +145,10 @@ def run_supervised(
     )
 
     def _save(st: Any, step_id: int) -> Optional[str]:
+        nonlocal phase
         if ckpt is None:
             return None
+        phase = "save"
         if writer:
             path = writer.save(st, step_id)
         else:
@@ -166,7 +197,28 @@ def run_supervised(
             ),
         }
 
-    watchdog = StepWatchdog(watchdog_secs, get_context=_wd_context)
+    def _escalate(label: str) -> None:
+        """Watchdog escalation: the straggler never finished — leave a
+        typed ``hang`` marker and exit the leg so the supervisor can
+        classify and relaunch.  ``os._exit`` is deliberate: the training
+        thread is wedged inside the very call we are escalating out of."""
+        if marker_path:
+            write_crash_marker(
+                marker_path, phase="step", gstep=gstep,
+                steps_run=steps_run, failure_class="hang", label=label,
+            )
+        os._exit(HANG_EXIT_CODE)
+
+    escalate_n = watchdog_escalation_from_env()
+    watchdog = StepWatchdog(
+        watchdog_secs,
+        get_context=_wd_context,
+        compile_budget_secs=watchdog_compile_budget_from_env(
+            watchdog_compile_secs, watchdog_secs
+        ),
+        escalate_after=escalate_n,
+        on_escalate=_escalate if escalate_n > 0 else None,
+    )
     preempt = (
         PreemptionHandler() if handle_signals else PreemptionHandler(())
     )
@@ -202,8 +254,13 @@ def run_supervised(
                 try:
                     while True:
                         # Arm BEFORE the fetch: a stalled producer is
-                        # exactly the hang the watchdog exists for.
-                        watchdog.arm(f"step {gstep}")
+                        # exactly the hang the watchdog exists for.  The
+                        # process's first step pays the XLA compile, so it
+                        # gets the compile-grace budget instead of the step
+                        # budget (ISSUE 15 satellite).
+                        watchdog.arm(f"step {gstep}",
+                                     compile=steps_run == 0)
+                        phase = "fetch"
                         try:
                             g, (x, y) = next(segment)
                         except StopIteration:
@@ -219,6 +276,22 @@ def run_supervised(
                             preempted = True
                             break
                         epoch, i = divmod(g, steps_per_epoch)
+                        if g in quarantine:
+                            # Supervisor poison-batch exclusion: a step the
+                            # anomaly guard already fail-fasted on is
+                            # skipped outright — same advance-past
+                            # semantics as a rollback skip.
+                            watchdog.disarm()
+                            emit(f"step {g} quarantined "
+                                 "(MPI4DL_QUARANTINE_STEPS); skipping")
+                            if runlog is not None:
+                                runlog.write("quarantine", gstep=g,
+                                             epoch=epoch, step=i)
+                            gstep = g + 1
+                            if gstep % steps_per_epoch == 0:
+                                _boundary_save(state, gstep)
+                            continue
+                        phase = "compile" if steps_run == 0 else "step"
                         faults.before_step(g)
                         x = faults.poison_batch(g, x)
                         timer.start()
@@ -227,6 +300,7 @@ def run_supervised(
                             loss = float(metrics["loss"])  # blocks on device
                         ms = timer.stop()
                         watchdog.disarm()
+                        phase = "loop"
                         loss = faults.poison_loss(g, loss)
 
                         reason = (
@@ -314,6 +388,21 @@ def run_supervised(
                     # whole run just to re-skip the same poison batch.
                     if gstep % steps_per_epoch == 0:
                         _boundary_save(state, gstep)
+    except BaseException as e:
+        # The leg's structured last words (ISSUE 15): phase + step + error,
+        # written BEFORE the exception propagates so the supervisor can
+        # classify this death even if the interpreter never unwinds
+        # further.  write_crash_marker itself never raises.
+        if marker_path:
+            extra = {}
+            spec = getattr(e, "spec", None)
+            if isinstance(spec, str) and spec:
+                extra["shrunk_spec"] = spec  # MeshShrunk carries it
+            write_crash_marker(
+                marker_path, phase=phase, gstep=gstep,
+                steps_run=steps_run, error=e, **extra,
+            )
+        raise
     finally:
         if writer is not None:
             writer.close()

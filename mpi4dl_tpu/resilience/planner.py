@@ -1,0 +1,320 @@
+"""Degrade-and-continue planner (ISSUE 15 tentpole, planning half).
+
+When a leg dies of a resource failure (``oom_compile`` / ``oom_step`` /
+``mesh_shrunk``), retrying the same config is doomed — the supervisor needs
+a *feasible* geometry to relaunch into.  The planner walks a documented
+**degradation ladder**, cumulative (each rung adds one more lever on top of
+the previous ones), in this order:
+
+1. **``spatial-until auto``** — re-place the SP→LP junction from the
+   analytical placement frontier (``parallel/spatial.choose_spatial_until``,
+   PR 12: placement is the dominant constant-term lever, 47.6 vs 87.5 GB at
+   the 8K flagship).  Plain-SP family only: moving the junction of an
+   sp_pipeline state RE-PACKS ``sp_buf``/``tail_buf`` leaf shapes, which
+   orphans the checkpoint the relaunched leg must elastic-restore
+   (docs/resilience.md, elastic envelope) — feasibility includes
+   restorability.
+2. **halve ``parts``** — fewer in-flight micro-batches shrink the chunk
+   trail (the 1F1B O(parts) term); leaf-shape-preserving, proven elastic.
+3. **enable ``MPI4DL_STRIPE_BWD``** — stripe-wise backward through the SP
+   region bounds the backward working set to one H-stripe (PR 12: 81.6 vs
+   120.1 GB at parts=8); a RESOLVED layout field, so the relaunch is a
+   recorded reshape, not drift.
+4. **step down the SP geometry** — fewer spatial tiles (square grids step
+   16→4, strip slicings halve), which is also the only rung that reduces
+   the DEVICE footprint — the rung a ``mesh_shrunk`` re-plan lands on.
+
+Each candidate is validated by a **compile-only feasibility probe** before
+the supervisor relaunches: :func:`compile_probe` runs
+``benchmarks/mem_probe.py`` in a subprocess (a probe that OOMs must not
+take the supervisor with it) and reads the compiled
+``memory_analysis`` peak; a candidate is feasible when the probe compiles
+and — when a byte budget is known — fits it.  The chosen plan, its rungs,
+and the probe evidence ride the ``supervisor`` incident record.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import subprocess
+import sys
+import tempfile
+from typing import Any, Callable, Dict, List, Mapping, Optional
+
+# Families with a spatial region (the SP rungs only mean something there).
+_SPATIAL_FAMILIES = ("sp", "gems_sp")
+
+# Probe verdict for a candidate that failed to compile (or whose probe
+# subprocess died): infinitely infeasible, as opposed to None = "probe
+# could not run, accept with a warning".
+INFEASIBLE = math.inf
+
+
+@dataclasses.dataclass(frozen=True)
+class Plan:
+    """One feasible degraded config: the full flag set to relaunch with,
+    env-hatch additions, the delta vs the failing config, the ladder rungs
+    applied, and the probe evidence that admitted it."""
+
+    flags: Dict[str, Any]
+    env: Dict[str, str]
+    delta: Dict[str, Any]
+    rungs: List[str]
+    note: str
+    probe_evidence: Dict[str, Any]
+
+
+def _flag(flags: Mapping[str, Any], name: str, default: Any) -> Any:
+    return flags.get(name, default)
+
+
+def _first_sp_parts(flags: Mapping[str, Any]) -> int:
+    raw = str(_flag(flags, "num-spatial-parts", "4"))
+    head = raw.split(",")[0].strip()
+    return int(head) if head.lstrip("-").isdigit() else 4
+
+
+def required_devices(flags: Mapping[str, Any], family: str) -> int:
+    """Mesh size a config needs — mirrors ``MeshSpec.from_config`` without
+    importing the jax-bearing mesh module (the planner must stay runnable
+    inside a supervisor that never initializes a backend)."""
+    dp = int(_flag(flags, "data-parallel", 1))
+    split = max(int(_flag(flags, "split-size", 1)), 1)
+    if family not in _SPATIAL_FAMILIES:
+        return dp * split
+    sp = _first_sp_parts(flags)
+    spatial_size = int(_flag(flags, "spatial-size", 1))
+    tiles = sp if (spatial_size > 0 and sp > 1) else 1
+    return dp * split * tiles
+
+
+def _shrunk_devices(evidence: Optional[Mapping[str, Any]]) -> Optional[int]:
+    """Parse the surviving device count out of a ``mesh_shrunk`` spec
+    (``devices=4`` — the free-text arg of the fault / the slice's report)."""
+    spec = str((evidence or {}).get("shrunk_spec") or "")
+    for tok in spec.split(","):
+        k, _, v = tok.partition("=")
+        if k.strip() == "devices" and v.strip().isdigit():
+            return int(v.strip())
+    return None
+
+
+def degrade_candidates(flags: Mapping[str, Any],
+                       family: str) -> List[Plan]:
+    """The cumulative ladder: candidate *k* applies rungs 1..k (each
+    successive candidate strictly more aggressive).  Rungs whose
+    precondition fails (parts already 1, stripe already on, ...) are
+    skipped, so the list is exactly the moves still available below the
+    current config."""
+    cands: List[Plan] = []
+    cur = dict(flags)
+    env: Dict[str, str] = {}
+    delta: Dict[str, Any] = {}
+    rungs: List[str] = []
+
+    def push(note: str) -> None:
+        cands.append(Plan(
+            flags=dict(cur), env=dict(env), delta=dict(delta),
+            rungs=list(rungs), note=note, probe_evidence={},
+        ))
+
+    split = max(int(_flag(flags, "split-size", 1)), 1)
+    # Rung 1: analytical junction re-placement (plain-SP only: an
+    # sp_pipeline junction move re-packs buffers and orphans the ckpt).
+    if (family in _SPATIAL_FAMILIES and split <= 1
+            and str(_flag(flags, "spatial-until", "")) != "auto"):
+        cur["spatial-until"] = "auto"
+        delta["spatial-until"] = "auto"
+        rungs.append("spatial_until_auto")
+        push("junction re-placed from the analytical frontier")
+
+    # Rung 2: halve parts while the batch still divides.
+    parts = int(_flag(flags, "parts", 1))
+    batch = int(_flag(flags, "batch-size", 32))
+    times = int(_flag(flags, "times", 1))
+    if parts >= 2:
+        new_parts = parts // 2
+        groups = (2 * times * new_parts) if family in ("gems", "gems_sp") \
+            else new_parts
+        if groups >= 1 and batch % groups == 0:
+            cur["parts"] = new_parts
+            delta["parts"] = {"from": parts, "to": new_parts}
+            rungs.append("halve_parts")
+            push(f"parts {parts} -> {new_parts}")
+
+    # Rung 3: stripe-wise backward (resolved layout field — elastic).
+    stripe_on = (
+        bool(_flag(flags, "stripe-bwd", False))
+        or os.environ.get("MPI4DL_STRIPE_BWD", "0") not in ("", "0")
+    )
+    if family in _SPATIAL_FAMILIES and not stripe_on:
+        cur["stripe-bwd"] = True
+        env["MPI4DL_STRIPE_BWD"] = "1"
+        delta["stripe-bwd"] = {"from": False, "to": True}
+        rungs.append("stripe_bwd")
+        push("stripe-wise SP-region backward enabled")
+
+    # Rung 4: step down the SP geometry (the device-footprint rung).
+    # Square grids step a full side-halving (16 -> 4); strip slicings
+    # halve.  A step to 1 tile turns spatial tiling off entirely — allowed
+    # only for the plain-SP family (an un-tiled sp_pipeline region is not a
+    # supported engine shape).
+    sp = _first_sp_parts(flags)
+    slice_method = str(_flag(flags, "slice-method", "square"))
+    if family in _SPATIAL_FAMILIES and sp > 1:
+        new_sp = sp // 4 if slice_method == "square" else sp // 2
+        if new_sp >= 2 or (new_sp == 1 and split <= 1):
+            cur["num-spatial-parts"] = str(new_sp)
+            delta["num-spatial-parts"] = {"from": sp, "to": new_sp}
+            rungs.append("shrink_sp")
+            push(f"spatial tiles {sp} -> {new_sp}")
+    return cands
+
+
+def plan_degrade(
+    flags: Mapping[str, Any],
+    family: str,
+    failure_class: str,
+    *,
+    budget_gb: Optional[float] = None,
+    probe: Optional[Callable[[Mapping[str, Any], Mapping[str, str]],
+                             Optional[float]]] = None,
+    evidence: Optional[Mapping[str, Any]] = None,
+) -> Optional[Plan]:
+    """First feasible rung of the ladder, or ``None`` when the ladder is
+    exhausted.  Feasibility = (fits the surviving device budget, for
+    ``mesh_shrunk``) AND (the compile-only probe compiles and — with a
+    known ``budget_gb`` — fits it).  Probe outcomes ride the returned
+    plan's ``probe_evidence`` so the incident record can SAY why this
+    geometry was admitted."""
+    devices = (
+        _shrunk_devices(evidence) if failure_class == "mesh_shrunk" else None
+    )
+    skipped: List[Dict[str, Any]] = []
+    for cand in degrade_candidates(flags, family):
+        if devices is not None:
+            need = required_devices(cand.flags, family)
+            if need > devices:
+                skipped.append({"rungs": cand.rungs, "reason":
+                                f"needs {need} devices, have {devices}"})
+                continue
+        pe: Dict[str, Any] = {"skipped": skipped} if skipped else {}
+        if probe is not None:
+            peak = probe(cand.flags, cand.env)
+            if peak == INFEASIBLE:
+                skipped.append({"rungs": cand.rungs,
+                                "reason": "probe failed to compile"})
+                continue
+            if peak is None:
+                pe["probe"] = "unavailable — accepted unprobed"
+            else:
+                pe["probe_peak_gb"] = peak
+                pe["budget_gb"] = budget_gb
+                if budget_gb is not None and peak > budget_gb:
+                    skipped.append({
+                        "rungs": cand.rungs,
+                        "reason": f"probe peak {peak} GB > budget "
+                                  f"{budget_gb} GB",
+                    })
+                    continue
+        else:
+            pe["probe"] = "skipped (no probe configured)"
+        return dataclasses.replace(cand, probe_evidence=pe)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# The real feasibility probe: compile-only mem_probe in a subprocess
+# ---------------------------------------------------------------------------
+
+
+def _mem_probe_script() -> str:
+    import mpi4dl_tpu
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(
+        mpi4dl_tpu.__file__)))
+    return os.path.join(root, "benchmarks", "mem_probe.py")
+
+
+def _probe_argv(flags: Mapping[str, Any], family: str, model: str,
+                out_path: str) -> List[str]:
+    """Bench-flag dict → ``mem_probe.py`` argv (its family mode builds the
+    engine exactly as the benchmark runner would)."""
+    schedule = str(_flag(flags, "schedule", "gpipe"))
+    argv = [
+        "--family", family,
+        "--arch", "amoeba" if model == "amoebanet" else model,
+        "--schedule", schedule,
+        "--batch", str(_flag(flags, "batch-size", 32)),
+        "--image-size", str(_flag(flags, "image-size", 32)),
+        "--num-layers", str(_flag(flags, "num-layers", 18)),
+        "--num-filters", str(_flag(flags, "num-filters", 416)),
+        "--parts", str(_flag(flags, "parts", 1)),
+        "--split-size", str(_flag(flags, "split-size", 1)),
+        "--times", str(_flag(flags, "times", 1)),
+        "--spatial-size", str(_flag(flags, "spatial-size", 1)),
+        "--num-spatial-parts", str(_first_sp_parts(flags)),
+        "--slice-method", str(_flag(flags, "slice-method", "square")),
+        "--quant", str(_flag(flags, "quant", "off")),
+        "--out", out_path,
+    ]
+    su = _flag(flags, "spatial-until", None)
+    if su is not None and str(su) != "":
+        argv += ["--spatial-until", str(su)]
+    if bool(_flag(flags, "stripe-bwd", False)):
+        argv += ["--stripe-bwd"]
+    return argv
+
+
+def compile_probe(
+    family: str, model: str = "resnet", *, timeout: float = 900.0,
+    log: Callable[[str], None] = lambda s: None,
+) -> Callable[[Mapping[str, Any], Mapping[str, str]], Optional[float]]:
+    """Probe factory: returns ``probe(flags, env) -> peak_gb | INFEASIBLE |
+    None``.  Runs the compile-only ``mem_probe`` in a subprocess (a
+    candidate that still OOMs kills the probe process, not the supervisor)
+    and reads ``peak_gb_est`` from its JSON artifact."""
+
+    def probe(flags: Mapping[str, Any],
+              env_extra: Mapping[str, str]) -> Optional[float]:
+        script = _mem_probe_script()
+        if not os.path.exists(script):
+            return None
+        schedule = str(_flag(flags, "schedule", "gpipe"))
+        fd, out_path = tempfile.mkstemp(suffix=".json", prefix="mem_probe_")
+        os.close(fd)
+        env = dict(os.environ)
+        env.pop("MPI4DL_FAULT", None)  # a probe must never re-fire a fault
+        env.update(env_extra)
+        cmd = [sys.executable, script,
+               *_probe_argv(flags, family, model, out_path)]
+        try:
+            proc = subprocess.run(
+                cmd, env=env, capture_output=True, timeout=timeout,
+            )
+            if proc.returncode != 0:
+                log(f"[planner] probe rc={proc.returncode}: "
+                    f"{proc.stderr.decode(errors='replace')[-400:]}")
+                return INFEASIBLE
+            with open(out_path, "r", encoding="utf-8") as f:
+                data = json.load(f)
+            row = (data.get("schedules") or {}).get(schedule) or {}
+            peak = row.get("peak_gb_est")
+            return float(peak) if peak is not None else None
+        except subprocess.TimeoutExpired:
+            log("[planner] probe timed out — candidate treated as "
+                "infeasible")
+            return INFEASIBLE
+        except (OSError, ValueError) as e:
+            log(f"[planner] probe unavailable: {e!r}")
+            return None
+        finally:
+            try:
+                os.unlink(out_path)
+            except OSError:
+                pass
+
+    return probe

@@ -15,6 +15,23 @@ the eventual death diagnosable.
 
 Budget resolution: the ``--watchdog-secs`` flag, else the
 ``MPI4DL_WATCHDOG_SECS`` hatch, else 0 (off).
+
+Two refinements (ISSUE 15):
+
+- **Compile grace** — the first step of a process (and the first step after
+  every supervisor relaunch) includes a multi-minute XLA compile, so one
+  flat budget realistic for steady-state steps false-triggers a stall dump
+  during every compile.  A separate first-step budget
+  (``--watchdog-compile-secs`` / ``MPI4DL_WATCHDOG_COMPILE_SECS``, default
+  10× the step budget) applies while ``arm(..., compile=True)`` — the
+  supervised loop passes that until its first step completes.
+- **Escalation** — a straggler that never finishes must eventually become a
+  typed failure, not an endless stream of identical dumps.  With
+  ``escalate_after=N`` (``MPI4DL_WATCHDOG_ESCALATE``, 0 = off) the armed
+  deadline re-arms after each dump and the N-th consecutive dump of ONE
+  armed step calls ``on_escalate(label)`` — under the supervisor that
+  writes a ``hang`` crash marker and exits the leg so the supervisor can
+  classify and relaunch (:mod:`mpi4dl_tpu.resilience.supervisor`).
 """
 
 from __future__ import annotations
@@ -27,12 +44,39 @@ import time
 import traceback
 from typing import Callable, Optional
 
+# Exit status of a leg the watchdog escalated out of — the supervisor's
+# secondary `hang` evidence when the crash marker is unwritable.
+HANG_EXIT_CODE = 82
+
 
 def watchdog_budget_from_env(flag_value: Optional[float] = None) -> float:
     """Resolve the step budget: explicit flag wins, then the hatch, then 0."""
     if flag_value is not None:
         return float(flag_value)
     return float(os.environ.get("MPI4DL_WATCHDOG_SECS", "0") or 0.0)
+
+
+def watchdog_compile_budget_from_env(
+    flag_value: Optional[float] = None, step_budget: float = 0.0
+) -> float:
+    """Resolve the first-step/compile budget: explicit flag wins, then the
+    ``MPI4DL_WATCHDOG_COMPILE_SECS`` hatch, then 10× the step budget (a
+    realistic compile:step ratio for the engine families — the 8K flagship
+    compiles for minutes while steps run in seconds)."""
+    if flag_value is not None:
+        return float(flag_value)
+    env = float(os.environ.get("MPI4DL_WATCHDOG_COMPILE_SECS", "0") or 0.0)
+    if env > 0:
+        return env
+    return 10.0 * float(step_budget)
+
+
+def watchdog_escalation_from_env(flag_value: Optional[int] = None) -> int:
+    """Resolve the escalation dump count (0 = dump forever, never escalate):
+    explicit value wins, then the ``MPI4DL_WATCHDOG_ESCALATE`` hatch."""
+    if flag_value is not None:
+        return int(flag_value)
+    return int(os.environ.get("MPI4DL_WATCHDOG_ESCALATE", "0") or 0)
 
 
 def memory_report_lines() -> list:
@@ -81,16 +125,34 @@ def dump_stacks(out) -> None:
 class StepWatchdog:
     """Monitor thread firing a stderr diagnostic when an armed step exceeds
     ``budget_secs``.  ``budget_secs <= 0`` disables everything (``start``
-    spawns no thread; ``arm``/``disarm`` are no-ops)."""
+    spawns no thread; ``arm``/``disarm`` are no-ops).
+
+    ``compile_budget_secs`` (default: 10× ``budget_secs``) replaces the
+    budget for steps armed with ``compile=True`` — the first-step/compile
+    grace.  ``escalate_after=N`` (default 0 = off) re-arms after each dump
+    and calls ``on_escalate(label)`` once one armed step has dumped N
+    times — the hang path's exit from dump-forever."""
 
     def __init__(self, budget_secs: float,
                  get_context: Optional[Callable[[], object]] = None,
-                 out=None):
+                 out=None,
+                 compile_budget_secs: Optional[float] = None,
+                 escalate_after: int = 0,
+                 on_escalate: Optional[Callable[[str], None]] = None):
         self.budget = float(budget_secs)
+        self.compile_budget = (
+            float(compile_budget_secs) if compile_budget_secs is not None
+            else 10.0 * self.budget
+        )
+        self.escalate_after = int(escalate_after)
+        self.on_escalate = on_escalate
         self.get_context = get_context
         self.out = out  # None = sys.stderr at fire time (test-friendly)
         self.fired = 0
+        self.escalated = False
         self._deadline: Optional[float] = None
+        self._armed_budget = self.budget
+        self._dumps_this_arm = 0
         self._label = ""
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -120,12 +182,20 @@ class StepWatchdog:
 
     # -- arming ------------------------------------------------------------
 
-    def arm(self, label: str = "") -> None:
+    def arm(self, label: str = "", compile: bool = False) -> None:
+        """Arm for one step.  ``compile=True`` applies the compile-grace
+        budget instead of the step budget (the loop passes it for the
+        process's first step — the one that pays the XLA compile)."""
         if self.budget <= 0:
             return
         with self._lock:
             self._label = label
-            self._deadline = time.monotonic() + self.budget
+            self._armed_budget = (
+                self.compile_budget if compile and self.compile_budget > 0
+                else self.budget
+            )
+            self._dumps_this_arm = 0
+            self._deadline = time.monotonic() + self._armed_budget
 
     def disarm(self) -> None:
         if self.budget <= 0:
@@ -140,19 +210,39 @@ class StepWatchdog:
         while not self._stop.wait(poll):
             with self._lock:
                 deadline, label = self._deadline, self._label
+                armed_budget = self._armed_budget
             if deadline is not None and time.monotonic() > deadline:
-                self._dump(label)
+                self._dump(label, armed_budget)
+                escalate = False
                 with self._lock:
-                    # fire once per armed step; a re-arm resets the deadline
                     if self._deadline == deadline:
-                        self._deadline = None
+                        self._dumps_this_arm += 1
+                        if (self.escalate_after > 0
+                                and self._dumps_this_arm
+                                >= self.escalate_after):
+                            # N dumps of ONE armed step: the straggler is a
+                            # hang, not a blip — hand it to on_escalate.
+                            escalate = True
+                            self._deadline = None
+                        elif self.escalate_after > 0:
+                            # keep watching the SAME armed step
+                            self._deadline = (
+                                time.monotonic() + self._armed_budget
+                            )
+                        else:
+                            # fire once per armed step; a re-arm resets
+                            self._deadline = None
+                if escalate and self.on_escalate is not None:
+                    self.escalated = True
+                    self.on_escalate(label)
 
-    def _dump(self, label: str) -> None:
+    def _dump(self, label: str, budget: Optional[float] = None) -> None:
         self.fired += 1
         out = self.out if self.out is not None else sys.stderr
         out.write(
             f"\n=== mpi4dl_tpu watchdog: {label or 'step'} exceeded the "
-            f"{self.budget:.1f}s wall-clock budget ===\n"
+            f"{budget if budget is not None else self.budget:.1f}s "
+            "wall-clock budget ===\n"
         )
         if self.get_context is not None:
             try:

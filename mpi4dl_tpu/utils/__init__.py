@@ -1,3 +1,4 @@
 from mpi4dl_tpu.utils.misc import is_power_two, get_depth, Timer, StepMeter
+from mpi4dl_tpu.utils.retry import retry_io
 
-__all__ = ["is_power_two", "get_depth", "Timer", "StepMeter"]
+__all__ = ["is_power_two", "get_depth", "Timer", "StepMeter", "retry_io"]

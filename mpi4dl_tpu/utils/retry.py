@@ -1,0 +1,53 @@
+"""Bounded retry with exponential backoff for transient I/O (ISSUE 15
+satellite).
+
+Extracted from ``mpi4dl_tpu.data.fetch_batch_with_retry`` so the data
+pipeline and the checkpoint layer share ONE retry discipline: NFS blips,
+GCS-fuse eviction races, and stale-handle errors are transient and worth a
+couple of bounded retries; everything else (bad shapes, logic bugs) must
+propagate immediately — retrying those only delays the crash.  On
+exhaustion the ORIGINAL exception is re-raised, not the last one: the first
+failure is the honest evidence, later ones are usually the same fault
+echoing.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Tuple, Type, TypeVar
+
+T = TypeVar("T")
+
+
+def retry_io(
+    fn: Callable[[], T],
+    *,
+    retries: int = 2,
+    backoff: float = 0.05,
+    exceptions: Tuple[Type[BaseException], ...] = (OSError,),
+    no_retry: Tuple[Type[BaseException], ...] = (),
+    _sleep: Callable[[float], None] = time.sleep,
+) -> T:
+    """Call ``fn`` with up to ``retries`` retries around ``exceptions``,
+    sleeping ``backoff`` seconds (doubling each time) between attempts;
+    re-raises the ORIGINAL exception when the budget is exhausted.
+
+    ``no_retry`` carves deterministic subclasses out of ``exceptions``
+    (e.g. ``FileNotFoundError`` out of ``OSError``): those raise
+    immediately — a vanished file is not an NFS blip and will never
+    succeed on retry."""
+    delay = backoff
+    first = None
+    for remaining in range(retries, -1, -1):
+        try:
+            return fn()
+        except exceptions as e:
+            if no_retry and isinstance(e, no_retry):
+                raise
+            if first is None:
+                first = e
+            if remaining == 0:
+                raise first
+            _sleep(delay)
+            delay *= 2.0
+    raise AssertionError("unreachable")  # loop always returns or raises

@@ -417,6 +417,243 @@ def test_watchdog_disarmed_never_fires():
 
 
 # ---------------------------------------------------------------------------
+# Watchdog compile grace + escalation (ISSUE 15 satellites)
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_compile_grace_covers_the_first_step():
+    """A step armed with compile=True rides the compile budget; the same
+    duration under the plain step budget fires — both phases covered."""
+    out = io.StringIO()
+    wd = StepWatchdog(0.05, compile_budget_secs=5.0, out=out)
+    with wd:
+        wd.arm("step 0", compile=True)
+        time.sleep(0.25)
+        wd.disarm()
+        assert wd.fired == 0  # within compile grace
+        wd.arm("step 1")  # steady-state budget again
+        time.sleep(0.25)
+        wd.disarm()
+    assert wd.fired == 1
+    assert "step 0 exceeded" not in out.getvalue()
+    assert "step 1 exceeded the 0.1s" in out.getvalue()  # armed budget shown
+
+
+def test_watchdog_compile_budget_still_fires_when_exceeded():
+    out = io.StringIO()
+    wd = StepWatchdog(0.02, compile_budget_secs=0.1, out=out)
+    with wd:
+        wd.arm("step 0", compile=True)
+        time.sleep(0.05)
+        assert wd.fired == 0  # over step budget, under compile budget
+        time.sleep(0.3)
+        wd.disarm()
+    assert wd.fired >= 1
+
+
+def test_watchdog_compile_budget_resolution(monkeypatch):
+    from mpi4dl_tpu.resilience.watchdog import (
+        watchdog_compile_budget_from_env,
+        watchdog_escalation_from_env,
+    )
+
+    monkeypatch.delenv("MPI4DL_WATCHDOG_COMPILE_SECS", raising=False)
+    assert watchdog_compile_budget_from_env(None, 2.0) == 20.0  # 10x default
+    monkeypatch.setenv("MPI4DL_WATCHDOG_COMPILE_SECS", "7")
+    assert watchdog_compile_budget_from_env(None, 2.0) == 7.0
+    assert watchdog_compile_budget_from_env(3.0, 2.0) == 3.0  # flag wins
+    monkeypatch.delenv("MPI4DL_WATCHDOG_ESCALATE", raising=False)
+    assert watchdog_escalation_from_env() == 0
+    monkeypatch.setenv("MPI4DL_WATCHDOG_ESCALATE", "3")
+    assert watchdog_escalation_from_env() == 3
+    assert watchdog_escalation_from_env(1) == 1
+
+
+def test_loop_compile_grace_both_phases(capfd):
+    """Through the supervised loop: a slow FIRST step (the compile) stays
+    silent under the grace budget, an equally slow LATER step dumps."""
+    from mpi4dl_tpu.resilience.loop import run_supervised as _rs
+
+    jstep = _toy_step()
+    calls = {"n": 0}
+
+    def step(state, x, y):
+        n = calls["n"]
+        calls["n"] += 1
+        if n in (0, 2):
+            time.sleep(0.35)
+        return jstep(state, x, y)
+
+    res = _rs(step, _toy_state(), _ToyDataset(), global_batch=8,
+              steps_per_epoch=4, num_epochs=1, watchdog_secs=0.12,
+              watchdog_compile_secs=3.0)
+    assert res.final_step == 4
+    err = capfd.readouterr().err
+    assert "step 0 exceeded" not in err  # compile grace held
+    assert "step 2 exceeded" in err  # steady-state budget armed after
+
+
+def test_watchdog_escalates_after_n_dumps():
+    escalated = []
+    out = io.StringIO()
+    wd = StepWatchdog(0.03, escalate_after=2, on_escalate=escalated.append,
+                      out=out)
+    with wd:
+        wd.arm("step 3")
+        deadline = time.monotonic() + 3.0
+        while not escalated and time.monotonic() < deadline:
+            time.sleep(0.01)
+        wd.disarm()
+    assert escalated == ["step 3"] and wd.escalated
+    assert wd.fired >= 2  # dumped escalate_after times before escalating
+    # a re-armed step resets the dump count — no cross-step accumulation
+    wd2 = StepWatchdog(0.05, escalate_after=3,
+                       on_escalate=escalated.append, out=io.StringIO())
+    with wd2:
+        for i in range(3):
+            wd2.arm(f"step {i}")
+            time.sleep(0.12)  # one dump each, never 3 on one step
+            wd2.disarm()
+    assert not wd2.escalated
+
+
+def test_slow_step_fault_escalates_to_typed_hang_marker(tmp_path,
+                                                        monkeypatch, capfd):
+    """slow_step@2 + MPI4DL_WATCHDOG_ESCALATE: the straggler is dumped,
+    then ESCALATED — the watchdog writes a typed `hang` crash marker and
+    exits the leg (verified in-process by stubbing the exit)."""
+    from mpi4dl_tpu.resilience.supervisor import (
+        classify_failure,
+        read_crash_marker,
+    )
+
+    marker = str(tmp_path / "m.json")
+    monkeypatch.setenv("MPI4DL_CRASH_MARKER", marker)
+    monkeypatch.setenv("MPI4DL_WATCHDOG_ESCALATE", "2")
+    exited = []
+    monkeypatch.setattr("mpi4dl_tpu.resilience.loop.os._exit",
+                        lambda code: exited.append(code))
+    _run_toy(
+        tmp_path, steps=4,
+        faults=FaultInjector(FaultSpec("slow_step", 2, 0.9)),
+        watchdog_secs=0.15,
+    )
+    from mpi4dl_tpu.resilience.watchdog import HANG_EXIT_CODE
+
+    assert exited and exited[0] == HANG_EXIT_CODE
+    m = read_crash_marker(marker)
+    assert m is not None and m["failure_class"] == "hang"
+    assert classify_failure(HANG_EXIT_CODE, m).failure_class == "hang"
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint I/O retry (ISSUE 15 satellite: shared retry_io discipline)
+# ---------------------------------------------------------------------------
+
+
+def _flaky(real, fail_times, exc=OSError("transient")):
+    calls = {"n": 0}
+
+    def wrapper(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] <= fail_times:
+            raise exc
+        return real(*a, **kw)
+
+    wrapper.calls = calls
+    return wrapper
+
+
+def test_retry_io_bounded_backoff_and_original_exception():
+    from mpi4dl_tpu.utils import retry_io
+
+    sleeps = []
+    flaky = _flaky(lambda: 42, 2)
+    assert retry_io(flaky, retries=2, backoff=0.05,
+                    _sleep=sleeps.append) == 42
+    assert sleeps == [0.05, 0.1]  # exponential, bounded
+
+    first = OSError("the FIRST failure")
+    always = _flaky(lambda: 0, 99, exc=first)
+    with pytest.raises(OSError, match="the FIRST failure"):
+        retry_io(always, retries=2, _sleep=lambda s: None)
+    assert always.calls["n"] == 3  # 1 try + 2 retries, then fail-fast
+
+    # non-I/O errors propagate immediately — retrying only delays the crash
+    bad = _flaky(lambda: 0, 99, exc=ValueError("logic bug"))
+    with pytest.raises(ValueError):
+        retry_io(bad, retries=5, _sleep=lambda s: None)
+    assert bad.calls["n"] == 1
+
+    # no_retry carves deterministic subclasses out: a vanished file raises
+    # immediately (the torn-checkpoint fallback walk must stay prompt)
+    gone = _flaky(lambda: 0, 99, exc=FileNotFoundError("gone"))
+    with pytest.raises(FileNotFoundError):
+        retry_io(gone, retries=5, no_retry=(FileNotFoundError,),
+                 _sleep=lambda s: None)
+    assert gone.calls["n"] == 1
+
+
+def test_lost_shard_fallback_does_not_retry_missing_files(tmp_path,
+                                                          monkeypatch):
+    """lost_shard_files drill path: the walk past a checkpoint with
+    deleted shard files must not burn retry backoff on deterministic
+    FileNotFoundErrors."""
+    from mpi4dl_tpu.resilience import lose_shard_files
+
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save({"w": jnp.full((8,), 1.0)}, step_id=1)
+    mgr.save({"w": jnp.full((8,), 2.0)}, step_id=2)
+    lose_shard_files(mgr.latest_path())
+    sleeps = []
+    monkeypatch.setattr("mpi4dl_tpu.utils.retry.time.sleep", sleeps.append)
+    state, sid = mgr.restore_latest({"w": jnp.zeros((8,), jnp.float32)})
+    assert sid == 1 and not sleeps  # fell back with zero retry sleeps
+
+
+def test_shard_write_retries_transient_oserror(tmp_path, monkeypatch):
+    from mpi4dl_tpu import checkpoint as ckpt_mod
+
+    monkeypatch.setattr(ckpt_mod, "_IO_BACKOFF", 0.0)
+    flaky = _flaky(ckpt_mod._write_shard_file, 2)
+    monkeypatch.setattr(ckpt_mod, "_write_shard_file", flaky)
+    mgr = CheckpointManager(str(tmp_path))
+    state = {"w": jnp.arange(8.0)}
+    path = mgr.save(state, step_id=1)  # survives two transient failures
+    restored, sid = mgr.restore_latest({"w": jnp.zeros((8,), jnp.float32)})
+    assert sid == 1
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.arange(8.0, dtype=np.float32))
+    assert flaky.calls["n"] >= 3
+
+
+def test_shard_write_exhaustion_raises_original(tmp_path, monkeypatch):
+    from mpi4dl_tpu import checkpoint as ckpt_mod
+
+    monkeypatch.setattr(ckpt_mod, "_IO_BACKOFF", 0.0)
+    first = OSError("disk REALLY gone")
+    monkeypatch.setattr(ckpt_mod, "_write_shard_file",
+                        _flaky(ckpt_mod._write_shard_file, 99, exc=first))
+    mgr = CheckpointManager(str(tmp_path))
+    with pytest.raises(OSError, match="disk REALLY gone"):
+        mgr.save({"w": jnp.arange(8.0)}, step_id=1)
+    # the aborted transaction leaves no torn published checkpoint
+    assert mgr.latest_path() is None
+
+
+def test_manifest_read_retries_transient_oserror(tmp_path, monkeypatch):
+    from mpi4dl_tpu import checkpoint as ckpt_mod
+
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save({"w": jnp.arange(8.0)}, step_id=2)
+    monkeypatch.setattr(ckpt_mod, "_IO_BACKOFF", 0.0)
+    flaky = _flaky(ckpt_mod._read_text, 2)
+    monkeypatch.setattr(ckpt_mod, "_read_text", flaky)
+    _, sid = mgr.restore_latest({"w": jnp.zeros((8,), jnp.float32)})
+    assert sid == 2 and flaky.calls["n"] >= 3
+
+
+# ---------------------------------------------------------------------------
 # Background checkpoint writer
 # ---------------------------------------------------------------------------
 
