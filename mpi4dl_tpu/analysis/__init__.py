@@ -29,6 +29,7 @@ from mpi4dl_tpu.analysis.core import (
     build_project,
     load_baseline,
     run_rules,
+    stale_pragmas,
 )
 from mpi4dl_tpu.analysis.rules import RULE_TABLE, RULES_BY_NAME
 
@@ -43,6 +44,7 @@ __all__ = [
     "build_project",
     "load_baseline",
     "run_rules",
+    "stale_pragmas",
 ]
 
 

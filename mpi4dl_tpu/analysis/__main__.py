@@ -9,7 +9,10 @@ violations remain after baseline filtering, 1 otherwise, 2 on usage errors.
 ``python -m mpi4dl_tpu.analysis contracts ...`` dispatches to the
 compiled-artifact contract gate (analysis/contracts — lowers the engine
 families and diffs their StableHLO/jaxpr contracts against checked-in
-goldens; see its ``--help``).
+goldens; see its ``--help``).  ``python -m mpi4dl_tpu.analysis ircheck
+...`` dispatches to the IR-level shard-flow verifier (analysis/ircheck —
+replication flow, collective matching, donation safety, async
+well-formedness over the same engine builds; see its ``--help``).
 """
 
 from __future__ import annotations
@@ -53,6 +56,25 @@ def scope_filter(paths: List[str], scope: List[str]) -> List[str]:
     return out
 
 
+# Files whose declarations are the cross-file ground truth every other
+# module is checked against (mesh axes; the env-hatch registry).  A change
+# here invalidates --changed-only's file-local view: the evidence for a
+# violation in an UNCHANGED module can live in these files.
+CROSS_FILE_GROUND_TRUTH = ("mpi4dl_tpu/config.py", "mpi4dl_tpu/mesh.py")
+
+
+def cross_file_ground_truth(paths: List[str]) -> List[str]:
+    """The ground-truth files present in ``paths`` (normalized, relative
+    suffix match — paths arrive absolute from git)."""
+    hits = []
+    for p in paths:
+        norm = p.replace(os.sep, "/")
+        for g in CROSS_FILE_GROUND_TRUTH:
+            if norm.endswith("/" + g) or norm == g:
+                hits.append(g)
+    return sorted(set(hits))
+
+
 def changed_python_files(root: str) -> Optional[List[str]]:
     """Repo-relative ``.py`` paths touched per git (worktree + index +
     untracked), for ``--changed-only`` pre-commit runs.  None when git is
@@ -91,6 +113,10 @@ def main(argv=None) -> int:
         from mpi4dl_tpu.analysis.contracts.__main__ import main as contracts_main
 
         return contracts_main(argv[1:])
+    if argv and argv[0] == "ircheck":
+        from mpi4dl_tpu.analysis.ircheck.__main__ import main as ircheck_main
+
+        return ircheck_main(argv[1:])
 
     ap = argparse.ArgumentParser(
         prog="python -m mpi4dl_tpu.analysis",
@@ -112,6 +138,13 @@ def main(argv=None) -> int:
                          "disabled — both need a whole-tree scan)")
     ap.add_argument("--rule", action="append", default=None, metavar="NAME",
                     help="run only the named rule(s)")
+    ap.add_argument("--sarif", metavar="F", default=None,
+                    help="also write the (post-baseline) violations as a "
+                         "SARIF 2.1.0 log for GitHub code scanning")
+    ap.add_argument("--prune-pragmas", action="store_true",
+                    help="list stale `# analysis: ok(...)` pragmas (those "
+                         "that suppressed nothing on a whole-tree scan) "
+                         "for removal, instead of the normal report")
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--hatch-docs", action="store_true",
                     help="print the README env-hatch table from config.HATCHES")
@@ -136,17 +169,25 @@ def main(argv=None) -> int:
         print("analysis: --prune-baseline needs a whole-tree scan and "
               "cannot be combined with --changed-only", file=sys.stderr)
         return 2
+    if args.prune_pragmas and (args.changed_only or args.paths or args.rule):
+        # pragma staleness needs the FULL rule set over the FULL tree — a
+        # subset scan trivially "never needs" every pragma outside it
+        print("analysis: --prune-pragmas needs a whole-tree all-rules scan "
+              "and cannot be combined with --changed-only, --rule or "
+              "explicit paths", file=sys.stderr)
+        return 2
 
-    # `contracts` dispatches only as the FIRST token; a flag-first spelling
+    # Subcommands dispatch only as the FIRST token; a flag-first spelling
     # (`--json contracts`) would otherwise be treated as a scan path with
     # no .py files in it and exit 0 looking like a passed gate.
-    if "contracts" in args.paths:
-        print(
-            "analysis: `contracts` must come first: "
-            "python -m mpi4dl_tpu.analysis contracts [flags]",
-            file=sys.stderr,
-        )
-        return 2
+    for sub in ("contracts", "ircheck"):
+        if sub in args.paths:
+            print(
+                f"analysis: `{sub}` must come first: "
+                f"python -m mpi4dl_tpu.analysis {sub} [flags]",
+                file=sys.stderr,
+            )
+            return 2
 
     root = repo_root()
     partial_scan = False  # True only when actually scanning a subset
@@ -169,8 +210,23 @@ def main(argv=None) -> int:
                 print("analysis: no changed python files in scope",
                       file=sys.stderr)
                 return 0
-            paths = changed
-            partial_scan = True
+            widen = cross_file_ground_truth(changed)
+            if widen:
+                # Cross-file rules judge every OTHER file against the
+                # ground truth these files declare (mesh axes, env
+                # hatches): an edit here changes what is a violation in
+                # unchanged modules, so the scan must widen to the
+                # dependency set — the whole tree.
+                print(
+                    "analysis: --changed-only: cross-file ground truth "
+                    f"changed ({', '.join(widen)}); widening to a full "
+                    "scan so dependent findings in unchanged files are "
+                    "not missed", file=sys.stderr,
+                )
+                paths = default_paths(root)
+            else:
+                paths = changed
+                partial_scan = True
     else:
         paths = args.paths or default_paths(root)
     if not paths:
@@ -193,7 +249,37 @@ def main(argv=None) -> int:
         # partial scan that happens to include config.py would flag hatches
         # whose reads live in unscanned files.
         project.hatch_decl_in_scan = False
-    violations = run_rules(project, rules)
+    # Pragma staleness mirrors the dead-flag gating: only a whole-tree
+    # all-rules scan can say a pragma suppressed nothing.
+    whole_tree = not partial_scan and not args.paths and rules is RULE_TABLE
+    used_pragmas = set() if whole_tree else None
+    violations = run_rules(project, rules, used_pragmas=used_pragmas)
+    if used_pragmas is not None:
+        from mpi4dl_tpu.analysis.core import stale_pragmas
+
+        stale_p = stale_pragmas(project, used_pragmas)
+        if args.prune_pragmas:
+            for v in stale_p:
+                text = ""
+                src = next((f for f in project.files if f.rel == v.path),
+                           None)
+                if src is not None:
+                    lines = src.text.splitlines()
+                    if 0 < v.line <= len(lines):
+                        text = lines[v.line - 1].strip()
+                print(f"{v.path}:{v.line}: {text}")
+            print(
+                f"analysis: {len(stale_p)} stale pragma(s) listed for "
+                "removal", file=sys.stderr,
+            )
+            return 1 if stale_p else 0
+        violations = sorted(
+            violations + stale_p, key=lambda v: (v.path, v.line, v.rule)
+        )
+    elif args.prune_pragmas:
+        print("analysis: --prune-pragmas needs a whole-tree all-rules "
+              "scan", file=sys.stderr)
+        return 2
 
     stale: List[dict] = []
     if args.baseline:
@@ -212,6 +298,17 @@ def main(argv=None) -> int:
                 f"({len(kept)} kept)",
                 file=sys.stderr,
             )
+
+    if args.sarif:
+        from mpi4dl_tpu.analysis.sarif import sarif_log, write_sarif
+
+        descriptions = {r.name: r.description for r in RULE_TABLE}
+        descriptions["stale-pragma"] = (
+            "# analysis: ok(...) pragma that no longer suppresses anything"
+        )
+        write_sarif(args.sarif, sarif_log(
+            violations=violations, rule_descriptions=descriptions,
+        ))
 
     if args.json:
         print(json.dumps(

@@ -17,6 +17,9 @@ machine-checkable (the CI job uploads it as an artifact on failure):
   (scope, collective class) — async-pair/sync counts, payload bytes, or
   structurally exposed bytes (a collective that loses its start/done split
   becomes unhideable; ISSUE 9 / ROADMAP item 2);
+- ``ircheck``: the IR verifier's per-kind finding count moved (a clean
+  engine pins ``{}``; any growth names the regression class — wasted-wire,
+  divergent-collective, read-after-donate, ... — ISSUE 16);
 - ``meta``: schema/engine mismatch (golden unusable — regenerate).
 """
 
@@ -118,6 +121,16 @@ def diff_contracts(golden: dict, current: dict) -> List[dict]:
         _counted(golden, "overlap", "per_scope"),
         _counted(current, "overlap", "per_scope"),
     )
+
+    g_irc = _counted(golden, "ircheck")
+    c_irc = _counted(current, "ircheck")
+    for name in sorted(set(g_irc) | set(c_irc)):
+        if g_irc.get(name, 0) != c_irc.get(name, 0):
+            drifts.append({
+                "kind": "ircheck", "finding": name,
+                "count_golden": g_irc.get(name, 0),
+                "count_current": c_irc.get(name, 0),
+            })
     return drifts
 
 
@@ -270,6 +283,12 @@ def render_drift_report(engine: str, drifts: List[dict]) -> str:
             lines.append(
                 f"  overlap scope {d['scope']}: {d['op']} "
                 + ", ".join(bits) + extra
+            )
+        elif kind == "ircheck":
+            lines.append(
+                f"  ircheck finding {d['finding']}: count "
+                f"{_fmt_delta(d['count_golden'], d['count_current'])} — "
+                "run `python -m mpi4dl_tpu.analysis ircheck` for details"
             )
         elif kind == "sharding":
             if "count_golden" in d:

@@ -18,8 +18,12 @@ from typing import Dict, List, Optional
 
 # Schema 2: adds the ``overlap`` section (per-scope per-class async-pair/
 # sync counts, payload bytes, structurally exposed bytes from the compiled
-# scheduled HLO).  Goldens with schema 1 are unusable — regenerate.
-CONTRACT_SCHEMA = 2
+# scheduled HLO).  Schema 3: adds the ``ircheck`` section (per-kind IR
+# verifier finding counts over the jaxpr + compiled HLO — a clean engine
+# pins ``{}``, so a refactor that introduces a wasted-wire reduction or an
+# unpaired async op fails the gate).  Goldens with an older schema are
+# unusable — regenerate.
+CONTRACT_SCHEMA = 3
 
 # jaxpr collective primitives -> the mesh-axis parameter that names them.
 _JAXPR_COLLECTIVES = ("psum", "pmax", "pmin", "ppermute", "all_gather",
@@ -160,6 +164,8 @@ def extract_contract(family: str, build=None) -> dict:
     # just traced by .lower(), so this re-derivation is nearly free).
     jaxpr = jax.make_jaxpr(step)(*args)
 
+    compiled_text = compiled_text_of(lowered)
+
     return {
         "schema": CONTRACT_SCHEMA,
         "engine": family,
@@ -181,24 +187,35 @@ def extract_contract(family: str, build=None) -> dict:
                 jax.tree_util.tree_leaves(lowered.in_avals)
             ),
         },
-        "overlap": _overlap_section(lowered),
+        "overlap": _overlap_section(compiled_text),
+        "ircheck": _ircheck_section(jaxpr, compiled_text, family),
     }
 
 
-def _overlap_section(lowered) -> dict:
-    """The compiled scheduled HLO's structural overlap projection
-    (obs/overlap.py): which collectives ride async start/done pairs vs
-    sync ops, per scope, with payload and structurally-exposed bytes —
-    a collective compiled *without* a start/done split can never hide
-    under compute, so a sync count that grows is an overlap regression no
-    benchmark has to measure first.  The compile bypasses the persistent
-    compilation cache — it keys on the program minus debug metadata, so a
-    scope-less executable compiled elsewhere could alias this build and
-    hand back HLO without op_name paths (the obs/hbm.py attribution caveat
-    applies here verbatim)."""
-    import jax
+def _ircheck_section(jaxpr, compiled_text: str, family: str) -> dict:
+    """Per-kind IR-verifier finding counts (analysis/ircheck) over the
+    jaxpr and the compiled scheduled HLO.  ``{}`` = the engine proves
+    clean; any nonzero count names the regression class directly."""
+    from mpi4dl_tpu.analysis.ircheck import (
+        check_hlo,
+        check_jaxpr,
+        finding_counts,
+    )
 
-    from mpi4dl_tpu.obs.overlap import structural_overlap
+    findings = check_jaxpr(jaxpr, family=family)
+    findings += check_hlo(compiled_text, family=family)
+    return finding_counts(findings)
+
+
+def compiled_text_of(lowered) -> str:
+    """Compile a lowered computation and return the scheduled HLO text.
+    The compile bypasses the persistent compilation cache — it keys on the
+    program minus debug metadata, so a scope-less executable compiled
+    elsewhere could alias this build and hand back HLO without op_name
+    paths (the obs/hbm.py attribution caveat applies here verbatim).
+    Shared by the ``overlap``/``ircheck`` contract sections and
+    ``analysis.ircheck.check_family``."""
+    import jax
 
     cache_dir = jax.config.jax_compilation_cache_dir
     jax.config.update("jax_compilation_cache_dir", None)
@@ -206,7 +223,19 @@ def _overlap_section(lowered) -> dict:
         compiled = lowered.compile()
     finally:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
-    return structural_overlap(compiled.as_text())
+    return compiled.as_text()
+
+
+def _overlap_section(compiled_text: str) -> dict:
+    """The compiled scheduled HLO's structural overlap projection
+    (obs/overlap.py): which collectives ride async start/done pairs vs
+    sync ops, per scope, with payload and structurally-exposed bytes —
+    a collective compiled *without* a start/done split can never hide
+    under compute, so a sync count that grows is an overlap regression no
+    benchmark has to measure first."""
+    from mpi4dl_tpu.obs.overlap import structural_overlap
+
+    return structural_overlap(compiled_text)
 
 
 def _sorted_nested(d: dict) -> dict:

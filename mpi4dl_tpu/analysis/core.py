@@ -115,18 +115,23 @@ class SourceFile:
         return spans
 
     def suppressed(self, rule: str, line: int) -> bool:
+        return self.suppressing_line(rule, line) is not None
+
+    def suppressing_line(self, rule: str, line: int) -> Optional[int]:
+        """The pragma line that suppresses ``rule`` at ``line`` (None when
+        nothing does) — the attribution the stale-pragma direction needs."""
         def hit(rules: Set[str]) -> bool:
             return "*" in rules or rule in rules
 
         if line in self.pragmas and hit(self.pragmas[line]):
-            return True
+            return line
         # a pragma on a def line covers the whole function body
         for def_line, start, end in self.func_spans:
             if start <= line <= end and def_line in self.pragmas and hit(
                 self.pragmas[def_line]
             ):
-                return True
-        return False
+                return def_line
+        return None
 
     # -- import aliases ----------------------------------------------------
     @staticmethod
@@ -363,16 +368,55 @@ def build_project(paths: Sequence[str], root: Optional[str] = None) -> Project:
     )
 
 
-def run_rules(project: Project, rules: Sequence[Rule]) -> List[Violation]:
+def run_rules(
+    project: Project,
+    rules: Sequence[Rule],
+    used_pragmas: Optional[Set[Tuple[str, int]]] = None,
+) -> List[Violation]:
+    """Run rules with pragma suppression.  ``used_pragmas``, when given,
+    collects ``(rel_path, pragma_line)`` of every pragma that actually
+    suppressed a violation — the evidence :func:`stale_pragmas` subtracts
+    from the declared set."""
     by_path = {f.rel: f for f in project.files}
     out: List[Violation] = []
     for rule in rules:
         for v in rule.check(project):
             src = by_path.get(v.path)
-            if src is not None and src.suppressed(v.rule, v.line):
-                continue
+            if src is not None:
+                pline = src.suppressing_line(v.rule, v.line)
+                if pline is not None:
+                    if used_pragmas is not None:
+                        used_pragmas.add((src.rel, pline))
+                    continue
             out.append(v)
     out.sort(key=lambda v: (v.path, v.line, v.rule))
+    return out
+
+
+def stale_pragmas(
+    project: Project, used_pragmas: Set[Tuple[str, int]]
+) -> List[Violation]:
+    """``stale-pragma`` violations for every ``# analysis: ok(...)`` that
+    suppressed nothing on this run — the pragma mirror of the env-hatch
+    dead-flag direction, and like it only meaningful on a whole-tree
+    all-rules scan (a partial scan trivially "never needs" every pragma).
+    Package files only: test fixtures carry pragmas for rules they
+    deliberately do not trip."""
+    out: List[Violation] = []
+    for src in project.package_files():
+        for line, rules in sorted(src.pragmas.items()):
+            if (src.rel, line) in used_pragmas:
+                continue
+            out.append(Violation(
+                rule="stale-pragma",
+                path=src.rel,
+                line=line,
+                message=(
+                    f"pragma ok({', '.join(sorted(rules))}) no longer "
+                    "suppresses any finding — remove it (or it will mask "
+                    "the next real violation on this line)"
+                ),
+            ))
     return out
 
 

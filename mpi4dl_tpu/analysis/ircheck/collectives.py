@@ -1,0 +1,239 @@
+"""Collective-matching proofs: permutation tables and replica groups.
+
+jaxpr level — the upgrade of the AST analyzer's ``collective-axis`` rule
+(rule 1): where the AST check can only inspect *literal* permutation tables
+with no knowledge of the axis size, here the table in ``ppermute``'s params
+is always concrete (whatever Python built it) and the enclosing
+``shard_map`` equation carries the concrete mesh, so "is this perm an
+injective partial permutation of ``range(axis_size)``" becomes a proof:
+
+- duplicate source: one shard must send two different payloads on the same
+  edge — the program is ill-formed and XLA may reject or misroute it;
+- duplicate destination: two shards write one receive buffer — a data race
+  across ranks (the reference stack's mismatched ``MPI_Isend`` analog);
+- out-of-range index: a rank that does not exist at this mesh geometry —
+  the partner waits forever (deadlock).
+
+compiled-HLO level — the same proofs after GSPMD partitioning, against
+``source_target_pairs={{a,b},...}`` on ``collective-permute`` and
+``replica_groups={{...}}`` on every collective, bounded by the module
+header's ``num_partitions`` (the post-partitioning rank space).  Group
+checks: disjoint, equal-sized, ids in range — a ragged or overlapping
+group set means different ranks disagree about who participates in which
+reduction, the cross-program matching obligation the MPMD transfer plan
+(arXiv:2412.14374) turns into a correctness contract.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from mpi4dl_tpu.analysis.ircheck import (
+    Finding,
+    collective_axes,
+    eqn_scope,
+    join_scope,
+    shard_map_context,
+    sub_jaxprs,
+)
+
+_GROUPED_COLLECTIVES = (
+    "psum", "pmax", "pmin", "all_gather", "psum_scatter", "all_to_all",
+)
+
+
+def _perm_problems(perm: Sequence[Tuple[int, int]],
+                   size: Optional[int]) -> List[str]:
+    """Why ``perm`` is not an injective partial permutation of
+    ``range(size)`` (empty list = it is).  ``size=None`` skips the range
+    check (axis size unknown — e.g. a pmap axis outside shard_map)."""
+    problems: List[str] = []
+    srcs = [int(s) for s, _ in perm]
+    dsts = [int(d) for _, d in perm]
+    dup_src = sorted({s for s in srcs if srcs.count(s) > 1})
+    dup_dst = sorted({d for d in dsts if dsts.count(d) > 1})
+    if dup_src:
+        problems.append(f"duplicate source shard(s) {dup_src}")
+    if dup_dst:
+        problems.append(f"duplicate destination shard(s) {dup_dst}")
+    if size is not None:
+        oob = sorted({i for i in srcs + dsts if i < 0 or i >= size})
+        if oob:
+            problems.append(
+                f"shard index(es) {oob} out of range for axis size {size}"
+            )
+    return problems
+
+
+def _group_problems(groups: Sequence[Sequence[int]],
+                    size: Optional[int]) -> List[str]:
+    """Why ``groups`` is not an equal-sized disjoint partition-style group
+    set over ``range(size)`` (empty list = consistent)."""
+    problems: List[str] = []
+    if not groups:
+        return problems
+    lens = {len(g) for g in groups}
+    if len(lens) > 1:
+        problems.append(f"unequal group sizes {sorted(lens)}")
+    flat = [int(i) for g in groups for i in g]
+    dup = sorted({i for i in flat if flat.count(i) > 1})
+    if dup:
+        problems.append(f"shard(s) {dup} appear in more than one group")
+    if size is not None:
+        oob = sorted({i for i in flat if i < 0 or i >= size})
+        if oob:
+            problems.append(
+                f"shard index(es) {oob} out of range for {size} participants"
+            )
+        if not dup and not oob and len(lens) == 1 and len(flat) != size:
+            problems.append(
+                f"groups cover {len(flat)} of {size} participants"
+            )
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# jaxpr level
+# ---------------------------------------------------------------------------
+
+
+def jaxpr_collective_findings(closed_jaxpr, family: str = "") -> List[Finding]:
+    """``nonbijective-perm`` + ``mismatched-replica-groups`` findings over
+    one closed jaxpr, with axis sizes taken from enclosing shard_map
+    equations."""
+    out: List[Finding] = []
+
+    def walk(jx, axes: Dict[str, int], prefix: str = "") -> None:
+        jx = getattr(jx, "jaxpr", jx)
+        for eqn in jx.eqns:
+            prim = eqn.primitive.name
+            # jax resets the name stack when tracing control-flow bodies, so
+            # sub-jaxpr eqns carry *relative* scopes — re-prefix on descent.
+            inner = join_scope(prefix, eqn_scope(eqn))
+            if prim == "shard_map":
+                sizes, _ = shard_map_context(eqn)
+                body = eqn.params.get("jaxpr")
+                if body is not None:
+                    walk(body, sizes, inner)
+                continue
+            if prim == "ppermute":
+                ax = collective_axes(eqn)
+                size = None
+                if len(ax) == 1 and ax[0] in axes:
+                    size = axes[ax[0]]
+                elif ax and all(a in axes for a in ax):
+                    size = 1
+                    for a in ax:
+                        size *= axes[a]
+                perm = tuple(eqn.params.get("perm", ()))
+                for problem in _perm_problems(perm, size):
+                    out.append(Finding(
+                        kind="nonbijective-perm",
+                        scope=inner,
+                        message=(
+                            f"ppermute over axis {'/'.join(ax) or '?'}: "
+                            f"{problem} (perm {list(map(tuple, perm))})"
+                        ),
+                        family=family,
+                    ))
+            elif prim in _GROUPED_COLLECTIVES:
+                groups = eqn.params.get("axis_index_groups")
+                if groups:
+                    ax = collective_axes(eqn)
+                    size = None
+                    if all(a in axes for a in ax) and ax:
+                        size = 1
+                        for a in ax:
+                            size *= axes[a]
+                    for problem in _group_problems(groups, size):
+                        out.append(Finding(
+                            kind="mismatched-replica-groups",
+                            scope=inner,
+                            message=(
+                                f"{prim} over axis {'/'.join(ax) or '?'}: "
+                                f"axis_index_groups {problem}"
+                            ),
+                            family=family,
+                        ))
+            for sub in sub_jaxprs(eqn.params):
+                walk(sub, axes, inner)
+
+    walk(closed_jaxpr, {})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# compiled-HLO level
+# ---------------------------------------------------------------------------
+
+_PAIRS_RE = re.compile(r"source_target_pairs=\{(.*?)\}\}")
+_GROUPS_RE = re.compile(r"replica_groups=\{(.*?)\}\}")
+_PAIR_RE = re.compile(r"\{(-?\d+)\s*,\s*(-?\d+)\}")
+_GROUP_RE = re.compile(r"\{([\-\d,\s]*)\}")
+_NUM_PARTITIONS_RE = re.compile(r"num_partitions=(\d+)")
+_REPLICA_COUNT_RE = re.compile(r"replica_count=(\d+)")
+
+
+def participant_count(hlo_text: str) -> Optional[int]:
+    """num_partitions x replica_count from the module header (None when the
+    header carries neither — hand fixtures may omit them)."""
+    head = hlo_text.split("\n", 1)[0]
+    np_m = _NUM_PARTITIONS_RE.search(head)
+    rc_m = _REPLICA_COUNT_RE.search(head)
+    if np_m is None and rc_m is None:
+        return None
+    return (int(np_m.group(1)) if np_m else 1) * (
+        int(rc_m.group(1)) if rc_m else 1
+    )
+
+
+def hlo_collective_findings(hlo_text: str, family: str = "") -> List[Finding]:
+    """Post-partitioning ``nonbijective-perm`` / ``mismatched-replica-
+    groups`` findings from a compiled module's text."""
+    from mpi4dl_tpu.obs.hbm import parse_hlo_module
+    from mpi4dl_tpu.obs.timeline import collective_base
+
+    size = participant_count(hlo_text)
+    out: List[Finding] = []
+    comps, _ = parse_hlo_module(hlo_text)
+    for instrs in comps.values():
+        for ins in instrs:
+            base = collective_base(ins.opcode)
+            if base is None:
+                continue
+            if ins.opcode.endswith("-done"):
+                continue  # the pairs/groups live on the start half
+            if base == "collective-permute":
+                m = _PAIRS_RE.search(ins.raw)
+                if m:
+                    pairs = [(int(a), int(b))
+                             for a, b in _PAIR_RE.findall(m.group(1) + "}")]
+                    for problem in _perm_problems(pairs, size):
+                        out.append(Finding(
+                            kind="nonbijective-perm",
+                            scope=ins.scope,
+                            message=(
+                                f"{ins.opcode} {ins.name}: {problem} "
+                                f"(source_target_pairs {pairs})"
+                            ),
+                            family=family,
+                        ))
+            m = _GROUPS_RE.search(ins.raw)
+            if m:
+                groups = [
+                    [int(i) for i in g.split(",") if i.strip()]
+                    for g in _GROUP_RE.findall(m.group(1) + "}")
+                ]
+                groups = [g for g in groups if g]
+                for problem in _group_problems(groups, size):
+                    out.append(Finding(
+                        kind="mismatched-replica-groups",
+                        scope=ins.scope,
+                        message=(
+                            f"{ins.opcode} {ins.name}: replica_groups "
+                            f"{problem}"
+                        ),
+                        family=family,
+                    ))
+    return out

@@ -1,0 +1,241 @@
+"""Donation safety over the compiled (scheduled) HLO module.
+
+``jax.jit(..., donate_argnums=...)`` becomes an ``input_output_alias``
+table in the module header: each entry promises XLA may write output
+``{o}`` into the buffer of parameter ``(p, {idx})``.  The compiled module
+is scheduled (instruction order = execution order), so the donation
+contract is checkable structurally:
+
+- ``read-after-donate`` — some instruction reads the donated parameter
+  buffer at a schedule position *after* the instruction producing its
+  aliased output has run.  If XLA honors the alias the reader sees the
+  output's bytes, not the parameter's — silent corruption.  (XLA's own
+  buffer assignment inserts ``copy`` ops to avoid this, which is exactly
+  why a violation in a module we generate points at a *manually* asserted
+  alias — the Pallas ``input_output_aliasing``/halo-RDMA path this
+  verifier exists for.)
+- ``double-donation`` — one parameter buffer promised to two outputs: both
+  writers race for the same bytes.
+- ``malformed-carry-alias`` — a ``while`` whose carry tuple shape differs
+  from its body's parameter or root shape.  XLA aliases the loop carry in
+  place across iterations; a shape mismatch breaks that contract (jax's
+  scan/while lowering guarantees it — a hand-built loop must too).
+
+Reads/writes resolve through view ops (``get-tuple-element``, ``bitcast``,
+``tuple``) to the underlying buffer, matching obs/hbm.py's liveness model.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from mpi4dl_tpu.analysis.ircheck import Finding
+from mpi4dl_tpu.obs.hbm import Instr, parse_hlo_module
+
+_ALIAS_HEAD = "input_output_alias={"
+_ALIAS_ENTRY = re.compile(
+    r"\{\s*([\d,\s]*)\}:\s*\(\s*(\d+)\s*,\s*\{([\d,\s]*)\}"
+    r"(?:\s*,\s*(may-alias|must-alias))?\s*\)"
+)
+_PARAM_NUM = re.compile(r"parameter\((\d+)\)")
+_LAYOUT = re.compile(r"\{[\d,\s]*\}")
+
+
+def parse_input_output_alias(hlo_text: str) -> List[dict]:
+    """The header's donation table as
+    ``[{"output": (..), "param": int, "param_index": (..), "kind": str}]``.
+    Empty when the module donates nothing."""
+    head = hlo_text.split("\n", 1)[0]
+    start = head.find(_ALIAS_HEAD)
+    if start < 0:
+        return []
+    i = start + len(_ALIAS_HEAD) - 1
+    depth = 0
+    end = len(head)
+    for j in range(i, len(head)):
+        if head[j] == "{":
+            depth += 1
+        elif head[j] == "}":
+            depth -= 1
+            if depth == 0:
+                end = j
+                break
+    body = head[i + 1:end]
+    out = []
+    for m in _ALIAS_ENTRY.finditer(body):
+        o_idx, param, p_idx, kind = m.groups()
+        out.append({
+            "output": tuple(int(x) for x in o_idx.split(",") if x.strip()),
+            "param": int(param),
+            "param_index": tuple(
+                int(x) for x in p_idx.split(",") if x.strip()
+            ),
+            "kind": kind or "must-alias",
+        })
+    return out
+
+
+def _strip_layout(shape: str) -> str:
+    return _LAYOUT.sub("", shape).replace(" ", "")
+
+
+def _root_instr(instrs: Sequence[Instr]) -> Optional[Instr]:
+    for ins in instrs:
+        if ins.raw.lstrip().startswith("ROOT"):
+            return ins
+    return instrs[-1] if instrs else None
+
+
+def _view_roots(name: str, by_name: Dict[str, Instr],
+                _seen: Optional[Set[str]] = None) -> Set[str]:
+    """The non-view instruction name(s) whose buffer ``name`` aliases,
+    resolved through get-tuple-element/bitcast/tuple chains."""
+    if _seen is None:
+        _seen = set()
+    if name in _seen:
+        return set()
+    _seen.add(name)
+    ins = by_name.get(name)
+    if ins is None:
+        return {name}
+    if ins.opcode in ("get-tuple-element", "bitcast", "tuple"):
+        roots: Set[str] = set()
+        for op in ins.operands:
+            roots |= _view_roots(op, by_name, _seen)
+        return roots
+    return {name}
+
+
+def donation_findings(hlo_text: str, family: str = "") -> List[Finding]:
+    comps, entry = parse_hlo_module(hlo_text)
+    out: List[Finding] = []
+    out += _carry_alias_findings(comps, family)
+    aliases = parse_input_output_alias(hlo_text)
+    if not aliases or not entry:
+        return out
+    instrs = comps.get(entry, [])
+    by_name = {i.name: i for i in instrs}
+    pos = {i.name: k for k, i in enumerate(instrs)}
+
+    # double-donation: the same (param, param_index) promised twice.
+    seen: Dict[Tuple[int, Tuple[int, ...]], dict] = {}
+    for a in aliases:
+        key = (a["param"], a["param_index"])
+        if key in seen:
+            out.append(Finding(
+                kind="double-donation",
+                scope="",
+                message=(
+                    f"parameter {a['param']} index {list(a['param_index'])} "
+                    f"is aliased by two outputs "
+                    f"({list(seen[key]['output'])} and "
+                    f"{list(a['output'])}) — both writers target one buffer"
+                ),
+                family=family,
+            ))
+        else:
+            seen[key] = a
+
+    # Parameter-number -> instruction name.
+    params: Dict[int, str] = {}
+    for ins in instrs:
+        if ins.opcode == "parameter":
+            m = _PARAM_NUM.search(ins.raw)
+            if m:
+                params[int(m.group(1))] = ins.name
+
+    root = _root_instr(instrs)
+    for a in aliases:
+        pname = params.get(a["param"])
+        if pname is None or root is None:
+            continue
+        writer = _aliased_writer(a["output"], root, by_name)
+        if writer is None or writer not in pos:
+            continue
+        if writer == pname:
+            continue  # identity passthrough: the buffer never changes
+        wpos = pos[writer]
+        # The donated buffer: the parameter itself, or the gte(param, i)
+        # views selecting the aliased tuple element.
+        donated = {pname}
+        if a["param_index"]:
+            donated = {
+                ins.name for ins in instrs
+                if ins.opcode == "get-tuple-element"
+                and ins.operands and ins.operands[0] == pname
+                and re.search(r"index=(\d+)", ins.raw)
+                and int(re.search(r"index=(\d+)", ins.raw).group(1))
+                == a["param_index"][0]
+            }
+        for ins in instrs[wpos + 1:]:
+            if ins.name == writer or ins.opcode == "tuple":
+                continue  # the root tuple forwards, it does not read
+            reads = set()
+            for op in ins.operands:
+                reads |= _view_roots(op, by_name)
+            if reads & donated:
+                out.append(Finding(
+                    kind="read-after-donate",
+                    scope=ins.scope,
+                    message=(
+                        f"{ins.opcode} {ins.name} reads donated parameter "
+                        f"{a['param']} ({pname}) after its aliased output "
+                        f"{list(a['output'])} was written by {writer} — "
+                        "the donation makes the read see the output's bytes"
+                    ),
+                    family=family,
+                    bytes=by_name[pname].bytes if pname in by_name else 0,
+                ))
+    return out
+
+
+def _aliased_writer(output_index: Tuple[int, ...], root: Instr,
+                    by_name: Dict[str, Instr]) -> Optional[str]:
+    """Name of the non-view instruction producing the ROOT (sub)value at
+    ``output_index`` — the point after which the donated buffer holds the
+    output."""
+    name = root.name
+    for idx in output_index:
+        ins = by_name.get(name)
+        if ins is None or ins.opcode != "tuple" or idx >= len(ins.operands):
+            break
+        name = ins.operands[idx]
+    roots = _view_roots(name, by_name)
+    return next(iter(roots)) if len(roots) == 1 else (name or None)
+
+
+def _carry_alias_findings(comps: Dict[str, List[Instr]],
+                          family: str) -> List[Finding]:
+    """``while`` carry/body shape agreement across every computation."""
+    out: List[Finding] = []
+    for instrs in comps.values():
+        for ins in instrs:
+            if ins.opcode != "while":
+                continue
+            m = re.search(r"body=(%[\w.\-]+)", ins.raw)
+            if not m:
+                continue
+            body = comps.get(m.group(1))
+            if not body:
+                continue
+            carry = _strip_layout(ins.shape)
+            b_root = _root_instr(body)
+            b_params = [b for b in body if b.opcode == "parameter"]
+            for label, other in (
+                ("body root", b_root.shape if b_root else None),
+                ("body parameter",
+                 b_params[0].shape if len(b_params) == 1 else None),
+            ):
+                if other is not None and _strip_layout(other) != carry:
+                    out.append(Finding(
+                        kind="malformed-carry-alias",
+                        scope=ins.scope,
+                        message=(
+                            f"while {ins.name}: carry shape {carry} != "
+                            f"{label} shape {_strip_layout(other)} — the "
+                            "in-place carry alias is ill-formed"
+                        ),
+                        family=family,
+                    ))
+    return out

@@ -1,0 +1,355 @@
+"""Replication-flow abstract interpretation over closed jaxprs.
+
+The abstract value of a jaxpr variable is the set of *manual* mesh axes
+(the enclosing ``shard_map``'s axes) along which the value is provably
+replicated — every shard along the axis holds identical data.  The lattice
+is the subset lattice ordered by inclusion; the interpreter only ever
+*underclaims* (a value it cannot prove replicated gets the empty set), so
+each finding is a proof, not a heuristic:
+
+- ``wasted-wire``: a reducing collective (``psum``/``pmax``/``pmin``) over
+  axes its operand is already replicated along computes a value every shard
+  already holds — N-1 of N shards' payloads are wasted wire.  The byte
+  estimate is the equation's output payload.
+- ``divergent-collective``: a collective under a ``cond``/``while`` whose
+  predicate is *not* replicated along the collective's axis.  Shards can
+  then disagree about whether (or how many times) the collective executes —
+  on real interconnects that is a hang, the SPMD analog of mismatched MPI
+  calls (the deadlock class the MPMD program-graph work must exclude
+  structurally, arXiv:2412.14374).
+
+Transfer rules (conservative in the underclaiming direction):
+
+- literals, closed constants and no-input equations: replicated along every
+  manual axis;
+- ``psum``/``pmax``/``pmin``: output adds the reduced axes (ungrouped
+  reduces only — grouped results are replicated only within a group);
+- ``all_gather``: adds the gathered axis; ``pbroadcast``: numeric identity;
+- ``ppermute``/``psum_scatter``/``all_to_all``/``axis_index``: remove
+  their axes (a partial permute zero-fills non-destinations, scatter and
+  index are per-shard by construction);
+- anything else: the intersection of its operands' sets (elementwise ops
+  preserve replication; an op the interpreter does not know cannot mint
+  replication it cannot prove).
+
+``scan``/``while`` carries iterate to a fixpoint (the carry set shrinks
+monotonically in the subset lattice, so at most |axes| x carry-width
+passes); findings are emitted on one final converged pass only.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List, Optional
+
+from mpi4dl_tpu.analysis.ircheck import (
+    Finding,
+    aval_bytes,
+    collective_axes,
+    eqn_scope,
+    join_scope,
+    shard_map_context,
+    sub_jaxprs,
+)
+
+# Reducing collectives whose ungrouped output is replicated along the
+# reduced axes — and whose input already being so makes the wire wasted.
+_REDUCERS = ("psum", "pmax", "pmin", "psum2")
+
+# Collectives whose output varies per shard along their axes.
+_DEREPLICATORS = ("ppermute", "psum_scatter", "all_to_all")
+
+_COLLECTIVES = _REDUCERS + _DEREPLICATORS + (
+    "all_gather", "pbroadcast", "axis_index",
+)
+
+# Call-like primitives whose single sub-jaxpr's invars map 1:1 onto the
+# equation's invars (after ClosedJaxpr unwrapping).
+_DIRECT_CALLS = (
+    "pjit", "closed_call", "core_call", "call", "remat", "checkpoint",
+    "remat2", "custom_jvp_call", "custom_vjp_call", "custom_jvp_call_jaxpr",
+    "custom_vjp_call_jaxpr", "custom_lin",
+)
+
+
+def _unwrap(jx):
+    return getattr(jx, "jaxpr", jx)
+
+
+class _Interp:
+    def __init__(self, family: str):
+        self.family = family
+        self.findings: List[Finding] = []
+        # jax resets the name stack when tracing control-flow bodies; the
+        # enclosing equations' scopes are re-joined here (join_scope).
+        self._prefix = ""
+
+    # -- environment helpers ----------------------------------------------
+
+    def _read(self, env: Dict, var, all_axes: frozenset) -> frozenset:
+        if hasattr(var, "val"):  # Literal
+            return all_axes
+        return env.get(var, frozenset())
+
+    def _write(self, env: Dict, var, rep: frozenset) -> None:
+        env[var] = rep
+
+    @contextlib.contextmanager
+    def _entering(self, eqn):
+        old = self._prefix
+        self._prefix = join_scope(old, eqn_scope(eqn))
+        try:
+            yield
+        finally:
+            self._prefix = old
+
+    # -- the walk ----------------------------------------------------------
+
+    def walk(self, jx, env: Dict, axes: Dict[str, int],
+             pred_rep: frozenset, emit: bool) -> None:
+        """Interpret one (closed) jaxpr body in place over ``env``.
+
+        ``axes`` is the manual mesh context ({axis: size}; empty outside
+        shard_map), ``pred_rep`` the axes along which control flow reaching
+        this body is provably uniform, ``emit`` whether findings are
+        recorded (False during carry fixpoint iteration)."""
+        jx = _unwrap(jx)
+        all_axes = frozenset(axes)
+        for cv in getattr(jx, "constvars", ()):
+            env.setdefault(cv, all_axes)
+        for eqn in jx.eqns:
+            prim = eqn.primitive.name
+            in_reps = [self._read(env, v, all_axes) for v in eqn.invars]
+            if prim in _COLLECTIVES:
+                out_rep = self._collective(
+                    eqn, prim, in_reps, all_axes, pred_rep, emit
+                )
+                for ov in eqn.outvars:
+                    self._write(env, ov, out_rep)
+            elif prim == "shard_map":
+                with self._entering(eqn):
+                    self._shard_map(eqn, pred_rep, emit)
+                for ov in eqn.outvars:
+                    self._write(env, ov, frozenset())
+            elif prim == "scan":
+                with self._entering(eqn):
+                    self._scan(eqn, in_reps, env, axes, pred_rep, emit)
+            elif prim == "while":
+                with self._entering(eqn):
+                    self._while(eqn, in_reps, env, axes, pred_rep, emit)
+            elif prim == "cond":
+                with self._entering(eqn):
+                    self._cond(eqn, in_reps, env, axes, pred_rep, emit)
+            else:
+                subs = sub_jaxprs(eqn.params)
+                if subs:
+                    with self._entering(eqn):
+                        self._call(eqn, prim, subs, in_reps, env, axes,
+                                   pred_rep, emit)
+                else:
+                    out_rep = (frozenset.intersection(*in_reps)
+                               if in_reps else all_axes)
+                    for ov in eqn.outvars:
+                        self._write(env, ov, out_rep)
+
+    # -- collectives -------------------------------------------------------
+
+    def _collective(self, eqn, prim: str, in_reps, all_axes: frozenset,
+                    pred_rep: frozenset, emit: bool) -> frozenset:
+        ax = frozenset(collective_axes(eqn)) & all_axes
+        in_rep = frozenset.intersection(*in_reps) if in_reps else all_axes
+        # axis_index/pbroadcast move no wire — they cannot deadlock.
+        if emit and prim not in ("axis_index", "pbroadcast") \
+                and not ax <= pred_rep:
+            div = sorted(ax - pred_rep)
+            self.findings.append(Finding(
+                kind="divergent-collective",
+                scope=join_scope(self._prefix, eqn_scope(eqn)),
+                message=(
+                    f"{prim} over axis {'/'.join(sorted(ax))} executes "
+                    f"under control flow whose predicate is not replicated "
+                    f"along {'/'.join(div)} — shards can diverge on whether "
+                    "the collective runs (deadlock on a real interconnect)"
+                ),
+                family=self.family,
+                bytes=sum(aval_bytes(v.aval) for v in eqn.outvars),
+            ))
+        if prim in _REDUCERS:
+            grouped = eqn.params.get("axis_index_groups") is not None
+            if emit and ax and ax <= in_rep:
+                nbytes = sum(aval_bytes(v.aval) for v in eqn.outvars)
+                self.findings.append(Finding(
+                    kind="wasted-wire",
+                    scope=join_scope(self._prefix, eqn_scope(eqn)),
+                    message=(
+                        f"{prim} over axis {'/'.join(sorted(ax))} of a value "
+                        "already replicated along "
+                        f"{'/'.join(sorted(in_rep & ax))} — every shard "
+                        "already holds the result (double reduce?)"
+                    ),
+                    family=self.family,
+                    bytes=nbytes,
+                ))
+            return in_rep if grouped else (in_rep | ax)
+        if prim == "all_gather":
+            if eqn.params.get("axis_index_groups") is not None:
+                return in_rep
+            return in_rep | ax
+        if prim == "pbroadcast":
+            return in_rep
+        if prim == "axis_index":
+            return all_axes - ax
+        # ppermute / psum_scatter / all_to_all
+        return in_rep - ax
+
+    # -- structured control / calls ---------------------------------------
+
+    def _shard_map(self, eqn, pred_rep: frozenset, emit: bool) -> None:
+        sizes, in_reps = shard_map_context(eqn)
+        body = eqn.params.get("jaxpr")
+        if body is None:
+            return
+        env: Dict = {}
+        inner = _unwrap(body)
+        for var, rep in zip(inner.invars, in_reps):
+            env[var] = rep
+        # Control flow entering the shard_map body is uniform across every
+        # manual axis (the same traced program runs on every shard).
+        self.walk(body, env, sizes, frozenset(sizes), emit)
+
+    def _call(self, eqn, prim: str, subs, in_reps, env, axes,
+              pred_rep: frozenset, emit: bool) -> None:
+        all_axes = frozenset(axes)
+        sub = subs[0] if len(subs) == 1 else None
+        inner = _unwrap(sub) if sub is not None else None
+        if inner is not None and len(inner.invars) == len(eqn.invars) and (
+            prim in _DIRECT_CALLS or len(subs) == 1
+        ):
+            sub_env: Dict = {}
+            for var, rep in zip(inner.invars, in_reps):
+                sub_env[var] = rep
+            self.walk(sub, sub_env, axes, pred_rep, emit)
+            for ov, iv in zip(eqn.outvars, inner.outvars):
+                self._write(env, ov, self._read(sub_env, iv, all_axes))
+            return
+        # Unknown call structure: interpret the bodies with everything
+        # unknown (no replication claims, so no false wasted-wire) and
+        # uniform control (no divergence claims the mapping can't support).
+        for s in subs:
+            self.walk(s, {}, axes, frozenset(axes), emit)
+        for ov in eqn.outvars:
+            self._write(env, ov, frozenset())
+
+    def _scan(self, eqn, in_reps, env, axes, pred_rep: frozenset,
+              emit: bool) -> None:
+        all_axes = frozenset(axes)
+        body = eqn.params["jaxpr"]
+        inner = _unwrap(body)
+        nc = eqn.params.get("num_consts", 0)
+        ncar = eqn.params.get("num_carry", 0)
+        consts = in_reps[:nc]
+        carry = list(in_reps[nc:nc + ncar])
+        xs = in_reps[nc + ncar:]  # element slices keep the operand's rep
+        carry = self._fixpoint(
+            body, consts, carry, xs, axes, pred_rep,
+            n_out_carry=ncar,
+        )
+        sub_env: Dict = {}
+        for var, rep in zip(inner.invars, consts + carry + xs):
+            sub_env[var] = rep
+        self.walk(body, sub_env, axes, pred_rep, emit)
+        out_reps = [self._read(sub_env, v, all_axes) for v in inner.outvars]
+        for ov, rep in zip(eqn.outvars, out_reps):
+            self._write(env, ov, rep)
+
+    def _while(self, eqn, in_reps, env, axes, pred_rep: frozenset,
+               emit: bool) -> None:
+        all_axes = frozenset(axes)
+        cond = eqn.params["cond_jaxpr"]
+        body = eqn.params["body_jaxpr"]
+        cn = eqn.params.get("cond_nconsts", 0)
+        bn = eqn.params.get("body_nconsts", 0)
+        cond_consts = in_reps[:cn]
+        body_consts = in_reps[cn:cn + bn]
+        carry = list(in_reps[cn + bn:])
+
+        def cond_rep(carry_reps) -> frozenset:
+            c_env: Dict = {}
+            inner = _unwrap(cond)
+            for var, rep in zip(inner.invars, cond_consts + carry_reps):
+                c_env[var] = rep
+            self.walk(cond, c_env, axes, pred_rep, False)
+            return self._read(c_env, inner.outvars[0], all_axes)
+
+        carry = self._fixpoint(
+            body, body_consts, carry, [], axes,
+            pred_rep & cond_rep(carry), n_out_carry=len(carry),
+        )
+        pred = pred_rep & cond_rep(carry)
+        inner = _unwrap(body)
+        sub_env: Dict = {}
+        for var, rep in zip(inner.invars, body_consts + carry):
+            sub_env[var] = rep
+        if emit:
+            # The cond body's collectives diverge under the same predicate.
+            c_env: Dict = {}
+            c_inner = _unwrap(cond)
+            for var, rep in zip(c_inner.invars, cond_consts + carry):
+                c_env[var] = rep
+            self.walk(cond, c_env, axes, pred, True)
+            self.walk(body, sub_env, axes, pred, True)
+        else:
+            self.walk(body, sub_env, axes, pred, False)
+        for ov, iv in zip(eqn.outvars, inner.outvars):
+            # Loop exit is only uniform along axes the predicate is
+            # replicated over; elsewhere shards exit at different trips.
+            self._write(env, ov, self._read(sub_env, iv, all_axes) & pred)
+
+    def _cond(self, eqn, in_reps, env, axes, pred_rep: frozenset,
+              emit: bool) -> None:
+        all_axes = frozenset(axes)
+        branches = eqn.params["branches"]
+        idx_rep = in_reps[0] if in_reps else all_axes
+        inner_pred = pred_rep & idx_rep
+        out_reps: Optional[List[frozenset]] = None
+        for br in branches:
+            b_inner = _unwrap(br)
+            b_env: Dict = {}
+            for var, rep in zip(b_inner.invars, in_reps[1:]):
+                b_env[var] = rep
+            self.walk(br, b_env, axes, inner_pred, emit)
+            reps = [self._read(b_env, v, all_axes) & idx_rep
+                    for v in b_inner.outvars]
+            out_reps = reps if out_reps is None else [
+                a & b for a, b in zip(out_reps, reps)
+            ]
+        for ov, rep in zip(eqn.outvars, out_reps or []):
+            self._write(env, ov, rep)
+
+    def _fixpoint(self, body, consts, carry, xs, axes,
+                  pred_rep: frozenset, n_out_carry: int) -> List[frozenset]:
+        """Iterate a loop body's carry replication to a fixpoint (monotone
+        shrinking in the subset lattice — bounded, silent passes)."""
+        all_axes = frozenset(axes)
+        inner = _unwrap(body)
+        for _ in range(len(all_axes) * max(1, len(carry)) + 2):
+            sub_env: Dict = {}
+            for var, rep in zip(inner.invars, list(consts) + carry + list(xs)):
+                sub_env[var] = rep
+            self.walk(body, sub_env, axes, pred_rep, False)
+            new = [
+                self._read(sub_env, v, all_axes) & old
+                for v, old in zip(inner.outvars[:n_out_carry], carry)
+            ]
+            if new == carry:
+                break
+            carry = new
+        return carry
+
+
+def replication_findings(closed_jaxpr, family: str = "") -> List[Finding]:
+    """``wasted-wire`` + ``divergent-collective`` findings of one closed
+    jaxpr (typically ``jax.make_jaxpr(step)(*args)`` of an engine family)."""
+    interp = _Interp(family)
+    interp.walk(closed_jaxpr, {}, {}, frozenset(), True)
+    return interp.findings

@@ -1,0 +1,90 @@
+"""SARIF 2.1.0 output for analyzer violations and ircheck findings.
+
+One shared serializer so both gates render as GitHub code-scanning
+annotations from a single uploaded log (the ``github/codeql-action/
+upload-sarif`` step in CI): analyzer violations carry their real
+``path:line``; ircheck findings are IR-level (no single source line), so
+they anchor on the engine-family registry — the file whose builds produced
+the verified artifacts — with the family/scope context in the message.
+
+Kept dependency-free and minimal: tool driver + rule index + results, the
+subset GitHub ingests.  Schema: https://json.schemastore.org/sarif-2.1.0.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence
+
+_SCHEMA = "https://json.schemastore.org/sarif-2.1.0.json"
+
+# Where IR-level findings (which have no one source line) anchor.
+IRCHECK_ANCHOR = "mpi4dl_tpu/analysis/contracts/engines.py"
+
+
+def _result(rule_id: str, message: str, uri: str, line: int,
+            rule_index: Dict[str, int]) -> dict:
+    if rule_id not in rule_index:
+        rule_index[rule_id] = len(rule_index)
+    return {
+        "ruleId": rule_id,
+        "ruleIndex": rule_index[rule_id],
+        "level": "error",
+        "message": {"text": message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {
+                    "uri": uri,
+                    "uriBaseId": "%SRCROOT%",
+                },
+                "region": {"startLine": max(1, line)},
+            },
+        }],
+    }
+
+
+def sarif_log(violations: Sequence = (), ircheck_findings: Sequence = (),
+              rule_descriptions: Optional[Dict[str, str]] = None) -> dict:
+    """One SARIF log dict from analyzer ``Violation``s and/or ircheck
+    ``Finding``s."""
+    rule_index: Dict[str, int] = {}
+    results: List[dict] = []
+    for v in violations:
+        results.append(_result(v.rule, v.message, v.path, v.line,
+                               rule_index))
+    for f in ircheck_findings:
+        where = " / ".join(p for p in (f.family, f.scope) if p)
+        msg = f"[{where}] {f.message}" if where else f.message
+        results.append(_result(
+            f"ircheck/{f.kind}", msg, IRCHECK_ANCHOR, 1, rule_index,
+        ))
+    descriptions = rule_descriptions or {}
+    rules = [
+        {
+            "id": rid,
+            **({"shortDescription": {"text": descriptions[rid]}}
+               if rid in descriptions else {}),
+        }
+        for rid, _ in sorted(rule_index.items(), key=lambda kv: kv[1])
+    ]
+    return {
+        "$schema": _SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "mpi4dl-tpu-analysis",
+                    "informationUri":
+                        "https://github.com/OSU-Nowlab/MPI4DL",
+                    "rules": rules,
+                },
+            },
+            "results": results,
+        }],
+    }
+
+
+def write_sarif(path: str, log: dict) -> None:
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(log, fh, indent=2, sort_keys=True)
+        fh.write("\n")

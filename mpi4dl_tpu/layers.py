@@ -390,9 +390,12 @@ class BatchNorm(Layer):
                 jnp.square(stat_x.astype(acc_dt)), axis=axes
             )
             if sp is not None and sp.active and sp.bn_cross_tile:
-                # Cross-tile statistics: psum local (count, sum, sumsq).
+                # Cross-tile statistics: psum local (sum, sumsq).  The count
+                # is a trace-time constant (SPMD tiles share a shape), so its
+                # "reduce" is a static multiply — psum(1, axes) constant-folds
+                # to the axis-size product, no wire (ircheck: wasted-wire).
                 ax_names = tuple(a for a in (sp.axis_h, sp.axis_w) if a)
-                cnt = lax.psum(cnt, ax_names)
+                cnt = cnt * lax.psum(1, ax_names)
                 s = lax.psum(s, ax_names)
                 ss = lax.psum(ss, ax_names)
             mean = s / cnt

@@ -152,7 +152,9 @@ def _apply_fused_triple(cv: Conv2d, bn: BatchNorm, p_conv, p_bn, x, ctx,
     if sub.active and sub.bn_cross_tile:
         ax_names = tuple(a for a in (sub.axis_h, sub.axis_w) if a)
         with scope("bn_cross_tile"):
-            cnt = lax.psum(cnt, ax_names)
+            # Count is a trace-time constant: static multiply, not a wire
+            # psum (psum(1, axes) folds to the axis-size product).
+            cnt = cnt * lax.psum(1, ax_names)
             s = lax.psum(s, ax_names)
             ss = lax.psum(ss, ax_names)
     mean = s / cnt

@@ -373,7 +373,10 @@ def stripe_layer_run(layers, params_seq, x, ctx, acc=None, plan=None):
             cnt = jnp.asarray(n * h * w, acc_dt)
             if real_axes:
                 with scope("stripe_bwd_stats"):
-                    cnt = lax.psum(cnt, real_axes)
+                    # Count is a trace-time constant: static multiply, not a
+                    # wire psum (psum(1, axes) folds to the axis-size
+                    # product).
+                    cnt = cnt * lax.psum(1, real_axes)
                     s = lax.psum(s, real_axes)
                     ss = lax.psum(ss, real_axes)
             mean = s / cnt

@@ -72,7 +72,7 @@ from mpi4dl_tpu.parallel.partition import (
     pad_to,
     stat_leaf_info,
 )
-from mpi4dl_tpu.parallel.pipeline import grad_pmean
+from mpi4dl_tpu.parallel.pipeline import grad_pmean, metric_psum
 from mpi4dl_tpu.parallel.spatial import (
     apply_junction,
     apply_spatial_region,
@@ -379,9 +379,13 @@ def _make_sp_step(
                     branches, tail_flat, x_parts, y_parts, vary_axes
                 )
             with scope("loss_reduce"):
-                loss = lax.psum(loss_acc, AXIS_STAGE) / denom
-                acc = lax.psum(acc_acc, AXIS_STAGE) / denom
-                if tile_axes:
+                loss = metric_psum(loss_acc, (AXIS_STAGE,)) / denom
+                acc = metric_psum(acc_acc, (AXIS_STAGE,)) / denom
+                # Under 'gather' every tile device saw the full batch, so
+                # loss/acc are already tile-invariant and the pmean would be
+                # an identity over the wire (ircheck: wasted-wire); only the
+                # batch_split junction leaves per-tile batch shards to merge.
+                if tile_axes and spp.junction == "batch_split":
                     loss = lax.pmean(loss, tile_axes)
                     acc = lax.pmean(acc, tile_axes)
                 if grad_axes:
@@ -425,10 +429,12 @@ def _make_sp_step(
         if with_stats_tail:
             # Tail stats vary over the tile axes under junction='batch_split'
             # (distinct batch shards) and over data; identical over tiles
-            # under 'gather' (pmean harmless).
+            # under 'gather', where the pmean would move the whole stats
+            # vector over the wire to reproduce it (ircheck: wasted-wire) —
+            # skip it there.
             stt = tail_stats
             with scope("stats_reduce"):
-                if tile_axes:
+                if tile_axes and spp.junction == "batch_split":
                     stt = grad_pmean(stt, tile_axes, quant)
                 if grad_axes:
                     stt = grad_pmean(stt, grad_axes, quant)

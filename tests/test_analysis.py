@@ -1250,3 +1250,148 @@ def test_unquantized_collective_per_block_granularity(tmp_path):
         filename="mpi4dl_tpu/parallel/fix.py",
     )
     assert len(vs) == 1 and "stage_lineup" in vs[0].message
+
+
+# ---------------------------------------------------------------------------
+# Stale-pragma hygiene (--prune-pragmas)
+# ---------------------------------------------------------------------------
+
+
+def test_stale_pragma_detected_used_pragma_kept(tmp_path):
+    from mpi4dl_tpu.analysis import RULE_TABLE, build_project, run_rules
+    from mpi4dl_tpu.analysis.core import stale_pragmas
+
+    f = tmp_path / "mpi4dl_tpu" / "fix.py"
+    f.parent.mkdir(parents=True)
+    f.write_text(textwrap.dedent(
+        """
+        from jax import lax
+
+        def g(x):
+            return lax.psum(x, "nope")  # analysis: ok(collective-axis)
+
+        def h(x):
+            return x + 1  # analysis: ok(collective-axis)
+        """
+    ))
+    project = build_project([str(f)], root=str(tmp_path))
+    used = set()
+    vs = run_rules(project, RULE_TABLE, used_pragmas=used)
+    # the first pragma suppressed the real violation; nothing else fires
+    assert [v for v in vs if v.rule == "collective-axis"] == []
+    stale = stale_pragmas(project, used)
+    assert len(stale) == 1, stale
+    assert stale[0].rule == "stale-pragma"
+    assert stale[0].line == 8  # the h() pragma suppressed nothing
+    assert "remove it" in stale[0].message
+
+
+def test_prune_pragmas_rejects_partial_scans(tmp_path, capsys):
+    from mpi4dl_tpu.analysis.__main__ import main
+
+    assert main(["--prune-pragmas", "--changed-only"]) == 2
+    assert "whole-tree all-rules scan" in capsys.readouterr().err
+    assert main(["--prune-pragmas", "--rule", "collective-axis"]) == 2
+    capsys.readouterr()
+    assert main(["--prune-pragmas", str(tmp_path)]) == 2
+
+
+# ---------------------------------------------------------------------------
+# SARIF output (--sarif)
+# ---------------------------------------------------------------------------
+
+
+def test_sarif_output_for_violations(tmp_path, capsys):
+    from mpi4dl_tpu.analysis.__main__ import main
+
+    f = _write_violating_file(tmp_path)
+    sarif = tmp_path / "analysis.sarif"
+    rc = main([str(f), "--sarif", str(sarif)])
+    capsys.readouterr()
+    assert rc == 1
+    log = json.loads(sarif.read_text())
+    assert log["version"] == "2.1.0"
+    run = log["runs"][0]
+    results = run["results"]
+    assert len(results) == 1
+    r = results[0]
+    assert r["ruleId"] == "collective-axis"
+    loc = r["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("bad.py")
+    assert loc["region"]["startLine"] == 4
+    # the driver carries a rules entry for every referenced ruleId
+    rules = run["tool"]["driver"]["rules"]
+    assert rules[r["ruleIndex"]]["id"] == "collective-axis"
+
+
+# ---------------------------------------------------------------------------
+# --changed-only cross-file widening (ground-truth edits)
+# ---------------------------------------------------------------------------
+
+
+def _tmp_pkg(tmp_path):
+    pkg = tmp_path / "mpi4dl_tpu"
+    pkg.mkdir(parents=True, exist_ok=True)
+    return pkg
+
+
+def test_changed_only_widens_to_ground_truth_dependents(
+    tmp_path, monkeypatch, capsys
+):
+    """Editing a cross-file ground-truth module (mesh.py / config.py) must
+    widen --changed-only to a full scan: the evidence for a violation in an
+    UNCHANGED module lives in the changed file."""
+    import mpi4dl_tpu.analysis.__main__ as amain
+
+    pkg = _tmp_pkg(tmp_path)
+    mesh = pkg / "mesh.py"
+    mesh.write_text('AXIS_DATA = "data"\n')
+    dep = pkg / "dependent.py"
+    dep.write_text(
+        'from jax import lax\n\ndef f(x):\n    return lax.psum(x, "nope")\n'
+    )
+    monkeypatch.setattr(amain, "repo_root", lambda: str(tmp_path))
+    monkeypatch.setattr(
+        amain, "changed_python_files", lambda root: [str(mesh)]
+    )
+    rc = amain.main(["--changed-only"])
+    captured = capsys.readouterr()
+    assert "cross-file ground truth changed" in captured.err
+    assert "widening to a full scan" in captured.err
+    # the violation lives in dependent.py, which git did NOT report changed
+    assert rc == 1
+    assert "dependent.py" in captured.out
+
+
+def test_changed_only_stays_file_local_without_ground_truth(
+    tmp_path, monkeypatch, capsys
+):
+    import mpi4dl_tpu.analysis.__main__ as amain
+
+    pkg = _tmp_pkg(tmp_path)
+    clean = pkg / "clean.py"
+    clean.write_text("def f(x):\n    return x\n")
+    dep = pkg / "dependent.py"
+    dep.write_text(
+        'from jax import lax\n\ndef f(x):\n    return lax.psum(x, "nope")\n'
+    )
+    monkeypatch.setattr(amain, "repo_root", lambda: str(tmp_path))
+    monkeypatch.setattr(
+        amain, "changed_python_files", lambda root: [str(clean)]
+    )
+    rc = amain.main(["--changed-only"])
+    captured = capsys.readouterr()
+    assert "widening" not in captured.err
+    assert rc == 0  # file-local view by design when no ground truth moved
+
+
+def test_cross_file_ground_truth_matcher():
+    from mpi4dl_tpu.analysis.__main__ import cross_file_ground_truth
+
+    assert cross_file_ground_truth(
+        ["/abs/repo/mpi4dl_tpu/mesh.py", "/abs/repo/mpi4dl_tpu/ops/halo.py"]
+    ) == ["mpi4dl_tpu/mesh.py"]
+    assert cross_file_ground_truth(
+        ["/r/mpi4dl_tpu/config.py", "/r/mpi4dl_tpu/mesh.py"]
+    ) == ["mpi4dl_tpu/config.py", "mpi4dl_tpu/mesh.py"]
+    assert cross_file_ground_truth(["/r/notmpi4dl_tpu/mesh.py"]) == []

@@ -1,0 +1,195 @@
+"""Fixture-driven tests for the compiled-HLO accounting stack (ISSUE 16
+satellite: ``obs/hlo_stats.py`` async-chain parsing had no coverage).
+
+Everything here runs on hand-written scheduled-HLO text — no compile, no
+devices — exercising the exact textual shapes XLA emits: named
+``*-start``/``*-done`` pairs, nested ``async-update`` glue, and the generic
+``async-start`` wrapper around a collective computation.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mpi4dl_tpu.obs.hbm import parse_hlo_module
+from mpi4dl_tpu.obs.hlo_stats import (
+    _tensor_bytes,
+    hlo_collective_stats,
+    clean_scope_path,
+)
+from mpi4dl_tpu.obs.overlap import structural_overlap
+
+
+# A named collective-permute-start/-done pair whose window holds real
+# compute (a dot), a sync all-reduce, and a -done line that must NOT be
+# double-counted.
+_HLO_PAIRED = """\
+HloModule paired, is_scheduled=true
+
+%add (a: f32[], b: f32[]) -> f32[] {
+  %a = f32[] parameter(0)
+  %b = f32[] parameter(1)
+  ROOT %s = f32[] add(%a, %b)
+}
+
+ENTRY %main (p0: f32[16], w: f32[16,16]) -> f32[16] {
+  %p0 = f32[16]{0} parameter(0)
+  %w = f32[16,16]{1,0} parameter(1)
+  %cps = (f32[16]{0}, f32[16]{0}) collective-permute-start(%p0), source_target_pairs={{0,1},{1,0}}, metadata={op_name="jit(step)/shmap/halo_exchange_spw/cp"}
+  %mm = f32[16]{0} dot(f32[16]{0} %p0, f32[16,16]{1,0} %w), lhs_contracting_dims={0}, rhs_contracting_dims={0}, metadata={op_name="jit(step)/shmap/cell00/mm"}
+  %cpd = f32[16]{0} collective-permute-done(%cps)
+  %ar = f32[16]{0} all-reduce(%cpd), replica_groups={}, to_apply=%add, metadata={op_name="jit(step)/shmap/grad_reduce/ar"}
+  ROOT %r = f32[16]{0} add(%ar, %mm)
+}
+"""
+
+
+def test_tensor_bytes():
+    assert _tensor_bytes("f32[16]{0}") == 64
+    assert _tensor_bytes("bf16[2,16,16,8]{3,2,1,0}") == 8192
+    assert _tensor_bytes("pred[]") == 1
+    assert _tensor_bytes("(f32[4], f32[4])") == 0  # tuples handled upstream
+
+
+def test_collective_stats_counts_start_once_with_result_bytes():
+    stats = hlo_collective_stats(_HLO_PAIRED)
+    # The pair is ONE transfer, counted at -start with the RESULT element
+    # (parts[1]) of the start tuple — not the whole tuple, not the done.
+    assert stats["collective-permute"] == {"count": 1, "bytes": 64}
+    assert stats["all-reduce"] == {"count": 1, "bytes": 64}
+    assert stats["total_count"] == 2
+    assert stats["total_bytes"] == 128
+
+
+def test_collective_stats_sync_tuple_sums_elements():
+    hlo = """\
+HloModule synctuple, is_scheduled=true
+
+ENTRY %main (p0: f32[8], p1: f32[4]) -> f32[8] {
+  %p0 = f32[8]{0} parameter(0)
+  %p1 = f32[4]{0} parameter(1)
+  %aa = (f32[8]{0}, f32[4]{0}) all-to-all(%p0, %p1), dimensions={0}
+  %g0 = f32[8]{0} get-tuple-element(%aa), index=0
+  ROOT %r = f32[8]{0} add(%g0, %g0)
+}
+"""
+    stats = hlo_collective_stats(hlo)
+    # Sync tuple form: every element is payload.
+    assert stats["all-to-all"] == {"count": 1, "bytes": 32 + 16}
+
+
+def test_parse_hlo_module_shapes_and_scopes():
+    comps, entry = parse_hlo_module(_HLO_PAIRED)
+    assert entry == "%main"
+    assert set(comps) == {"%main", "%add"}
+    by_name = {i.name: i for i in comps["%main"]}
+    cps = by_name["%cps"]
+    assert cps.opcode == "collective-permute-start"
+    assert tuple(cps.operands) == ("%p0",)
+    assert cps.scope == "halo_exchange_spw"
+    assert tuple(by_name["%cpd"].operands) == ("%cps",)
+    assert by_name["%mm"].scope == "cell00"
+    # -done is a view op for liveness purposes; the dot is not.
+    assert by_name["%cpd"].is_view and not by_name["%mm"].is_view
+
+
+def test_structural_overlap_async_pair_hidden_sync_exposed():
+    ledger = structural_overlap(_HLO_PAIRED)
+    halo = ledger["per_scope"]["halo_exchange_spw"]["collective-permute"]
+    # The dot inside the start/done window gives the pair FLOPs to hide
+    # under: structurally not exposed.
+    assert halo == {"async_pairs": 1, "sync": 0, "bytes": 64,
+                    "exposed_bytes": 0}
+    grad = ledger["per_scope"]["grad_reduce"]["all-reduce"]
+    # Sync collectives have no window at all: fully exposed.
+    assert grad == {"async_pairs": 0, "sync": 1, "bytes": 64,
+                    "exposed_bytes": 64}
+    assert ledger["totals"] == {"async_pairs": 1, "sync": 1, "bytes": 128,
+                                "exposed_bytes": 64}
+
+
+def test_structural_overlap_empty_window_is_exposed():
+    line = next(l for l in _HLO_PAIRED.splitlines() if " dot(" in l)
+    hlo = _HLO_PAIRED.replace(line + "\n", "")
+    halo = structural_overlap(hlo)["per_scope"]["halo_exchange_spw"][
+        "collective-permute"]
+    # Same pair, zero FLOPs scheduled in the window: nothing to hide under.
+    assert halo["async_pairs"] == 1 and halo["exposed_bytes"] == 64
+
+
+# The generic async wrapper: async-start whose wrapped computation holds the
+# collective, resolved to its done through NESTED async-update glue — the
+# chain shape this file previously had no coverage for.
+_HLO_GLUE = """\
+HloModule glue, is_scheduled=true
+
+%add (a: f32[], b: f32[]) -> f32[] {
+  %a = f32[] parameter(0)
+  %b = f32[] parameter(1)
+  ROOT %s = f32[] add(%a, %b)
+}
+
+%wrapped (wp: f32[32]) -> f32[32] {
+  %wp = f32[32]{0} parameter(0)
+  ROOT %war = f32[32]{0} all-reduce(%wp), replica_groups={}, to_apply=%add, metadata={op_name="jit(step)/shmap/stats_reduce/ar"}
+}
+
+ENTRY %main (p0: f32[32], w: f32[32,32]) -> f32[32] {
+  %p0 = f32[32]{0} parameter(0)
+  %w = f32[32,32]{1,0} parameter(1)
+  %as = ((f32[32]{0}), f32[32]{0}, u32[]) async-start(%p0), calls=%wrapped, metadata={op_name="jit(step)/shmap/stats_reduce/as"}
+  %mm = f32[32]{0} dot(f32[32]{0} %p0, f32[32,32]{1,0} %w), lhs_contracting_dims={0}, rhs_contracting_dims={0}
+  %u1 = ((f32[32]{0}), f32[32]{0}, u32[]) async-update(%as)
+  %u2 = ((f32[32]{0}), f32[32]{0}, u32[]) async-update(%u1)
+  %ad = f32[32]{0} async-done(%u2), calls=%wrapped
+  ROOT %r = f32[32]{0} add(%ad, %mm)
+}
+"""
+
+
+def test_async_wrapper_chain_resolves_through_nested_updates():
+    ledger = structural_overlap(_HLO_GLUE)
+    entry = ledger["per_scope"]["stats_reduce"]["all-reduce"]
+    # ONE pair: the done resolved through u2 -> u1 -> as; the wrapped
+    # computation's all-reduce line did NOT also count as a sync event
+    # (async glue callee bodies belong to their pair, not the caller).
+    assert entry["async_pairs"] == 1 and entry["sync"] == 0
+    assert entry["bytes"] == 128
+    assert entry["exposed_bytes"] == 0  # the dot hides it
+    assert ledger["totals"]["async_pairs"] == 1
+    assert ledger["totals"]["sync"] == 0
+
+
+def test_async_wrapper_without_collective_is_not_wire():
+    hlo = """\
+HloModule copystart, is_scheduled=true
+
+%plain (wp: f32[8]) -> f32[8] {
+  %wp = f32[8]{0} parameter(0)
+  ROOT %n = f32[8]{0} negate(%wp)
+}
+
+ENTRY %main (p0: f32[8]) -> f32[8] {
+  %p0 = f32[8]{0} parameter(0)
+  %as = ((f32[8]{0}), f32[8]{0}, u32[]) async-start(%p0), calls=%plain
+  %ad = f32[8]{0} async-done(%as), calls=%plain
+  ROOT %r = f32[8]{0} add(%ad, %p0)
+}
+"""
+    ledger = structural_overlap(hlo)
+    assert ledger["totals"] == {"async_pairs": 0, "sync": 0, "bytes": 0,
+                                "exposed_bytes": 0}
+
+
+def test_clean_scope_path_strips_wrappers_and_framing():
+    assert clean_scope_path(
+        "jit(step)/jit(main)/jit(shmap_body)/jvp(sp_level0)/cell00/"
+        "halo_exchange_spw/ppermute"
+    ) == "sp_level0/cell00/halo_exchange_spw"
+    assert clean_scope_path(
+        "jit(step)/transpose(jvp(gpipe_scan))/while/body/checkpoint/"
+        "stage_handoff/ppermute"
+    ) == "gpipe_scan/stage_handoff"
