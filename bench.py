@@ -385,7 +385,13 @@ def _inner(platform: str, image_size: int, num_layers: int, num_filters: int,
                     family="bench",
                 )
                 rl.write("summary", **out)
-            print(f"[bench] telemetry -> {rl.path}", file=sys.stderr)
+            from mpi4dl_tpu.obs.metrics import write_metrics_file
+            from mpi4dl_tpu.obs.runlog import read_runlog
+
+            prom = os.path.splitext(rl.path)[0] + ".prom"
+            write_metrics_file(read_runlog(rl.path), prom)
+            print(f"[bench] telemetry -> {rl.path} (+ {prom})",
+                  file=sys.stderr)
         except Exception as e:  # noqa: BLE001 — telemetry must not kill bench
             print(f"[bench] telemetry failed: {e}", file=sys.stderr)
     print(json.dumps(out))

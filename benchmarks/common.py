@@ -571,6 +571,16 @@ def run(family: str, model: str, argv=None) -> dict:
             runlog.close()
             print(f"telemetry written to {runlog.path} "
                   f"(render: python -m mpi4dl_tpu.obs report {runlog.path})")
+            try:
+                from mpi4dl_tpu.obs.metrics import write_metrics_file
+                from mpi4dl_tpu.obs.runlog import read_runlog
+
+                prom = os.path.splitext(runlog.path)[0] + ".prom"
+                write_metrics_file(read_runlog(runlog.path), prom)
+                print(f"metrics snapshot written to {prom}")
+            except Exception as e:  # noqa: BLE001  # analysis: ok(swallow-except)
+                # deliberate: telemetry must never kill a run
+                print(f"note: metrics snapshot unavailable ({e})")
     print(meter.summary())
     return {
         "images_per_sec": meter.images_per_sec(),

@@ -163,6 +163,21 @@ HATCHES: Dict[str, Hatch] = {
               "Grad-norm guard limit (float; 0 = off): a step reporting "
               "metrics['grad_norm'] above it triggers the same rollback as "
               "a non-finite loss."),
+        Hatch("MPI4DL_FLIGHT_STEPS", "64",
+              "Flight-recorder ring capacity: the last N step records "
+              "(per-device memory watermarks, jit-cache probe) plus "
+              "checkpoint/anomaly/quarantine/preempt events kept in memory "
+              "and dumped as `flight.json` on anomaly, watchdog "
+              "escalation, preemption, and crash-marker writes "
+              "(docs/observability.md)."),
+        Hatch("MPI4DL_NO_FLIGHT", "0",
+              "1 = disable the flight recorder (no in-memory ring, no "
+              "`flight.json` dumps; the supervisor loses its fourth "
+              "evidence source)."),
+        Hatch("MPI4DL_METRICS_PORT", "<unset>",
+              "Default port for `python -m mpi4dl_tpu.obs metrics --serve` "
+              "(stdlib HTTP endpoint exposing the OpenMetrics text on "
+              "/metrics); unset = file-sink only."),
         Hatch("MPI4DL_TPU_TESTS", "0",
               "1 = opt in to real-TPU subprocess tests (the tunnel is slow "
               "and intermittently down)."),

@@ -1,21 +1,29 @@
 """CLI: ``python -m mpi4dl_tpu.obs report run.jsonl [more.jsonl ...]``,
-``... report --compare A.jsonl B.jsonl [--threshold PCT]``, and
-``... overlap --families lp,sp|all [--json] [--out F]``.
+``... report --compare A.jsonl B.jsonl [--threshold PCT]``,
+``... report --trend DIR [--trend-out F]``,
+``... overlap --families lp,sp|all [--json] [--out F]``,
+``... trace [--families lp,...|--hlo F|--runlog F] --out trace.json``, and
+``... metrics run.jsonl [--out F] [--serve [PORT]]``.
 
-``report`` renders the summary table of one or more RunLog files, or the
+``report`` renders the summary table of one or more RunLog files, the
 per-metric regression diff of two (docs/observability.md documents every
-field and the compare metrics).  ``overlap`` builds + compiles engine
-families on the virtual mesh (or reads an HLO text dump via ``--hlo``) and
-prints their exposed-wire ledgers (obs/overlap.py) — the CI
-``overlap-contract`` job's ledger artifact.  Exit status: 0 on success, 1
-when --compare finds a regression past the threshold, 2 on usage errors or
-unreadable files.
+field and the compare metrics), or the directory-wide trajectory + gate
+(obs/trend.py).  ``overlap`` builds + compiles engine families on the
+virtual mesh (or reads an HLO text dump via ``--hlo``) and prints their
+exposed-wire ledgers (obs/overlap.py) — the CI ``overlap-contract`` job's
+ledger artifact.  ``trace`` exports the same compiled artifacts (and/or a
+RunLog's measured walls) as Chrome/Perfetto trace-event JSON
+(obs/trace.py).  ``metrics`` renders a RunLog as OpenMetrics text
+(obs/metrics.py).  Exit status: 0 on success, 1 when --compare/--trend
+finds a regression past the threshold, 2 on usage errors or unreadable
+files.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import List, Optional
 
@@ -40,7 +48,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     rep.add_argument(
         "--threshold", type=float, default=5.0,
-        help="regression threshold in percent for --compare (default 5)",
+        help="regression threshold in percent for --compare/--trend "
+             "(default 5)",
+    )
+    rep.add_argument(
+        "--trend", default=None, metavar="DIR",
+        help="trajectory + newest-vs-previous regression gate over every "
+             "RunLog (*.jsonl) and bench artifact (BENCH_*.json) in DIR; "
+             "exit 1 when the newest run of a series regresses past "
+             "--threshold",
+    )
+    rep.add_argument(
+        "--trend-out", default=None, metavar="F",
+        help="also write the --trend JSON artifact to this file",
     )
     ovl = sub.add_parser(
         "overlap",
@@ -59,12 +79,55 @@ def main(argv: Optional[List[str]] = None) -> int:
                      help="machine-readable ledgers on stdout")
     ovl.add_argument("--out", default=None, metavar="F",
                      help="also write the JSON ledgers to this file")
+    trc = sub.add_parser(
+        "trace",
+        help="Chrome/Perfetto trace-event export: compiled engine families "
+             "(simulated wire + analytical + pipeline-tick lanes) and/or a "
+             "RunLog's measured step walls",
+    )
+    trc.add_argument(
+        "--families", default=None,
+        help="comma-separated engine families to compile and trace "
+             "('all' = every contract family)",
+    )
+    trc.add_argument("--hlo", default=None, metavar="F",
+                     help="trace an existing compiled-HLO text dump "
+                          "instead of building engines")
+    trc.add_argument("--runlog", default=None, metavar="F",
+                     help="add measured lanes from this RunLog .jsonl")
+    trc.add_argument("--out", default=None, metavar="F", required=True,
+                     help="write the trace-event JSON here "
+                          "(load in ui.perfetto.dev / chrome://tracing)")
+    met = sub.add_parser(
+        "metrics",
+        help="OpenMetrics/Prometheus text exposition of one RunLog",
+    )
+    met.add_argument("path", help="run .jsonl file")
+    met.add_argument("--out", default=None, metavar="F",
+                     help="write the exposition here (atomic) instead of "
+                          "stdout")
+    met.add_argument(
+        "--serve", nargs="?", type=int, const=-1, default=None,
+        metavar="PORT",
+        help="serve /metrics over stdlib HTTP, re-reading the RunLog per "
+             "scrape (PORT defaults to the MPI4DL_METRICS_PORT hatch)",
+    )
     args = ap.parse_args(argv)
 
     if args.cmd == "overlap":
         return _overlap_cmd(args)
+    if args.cmd == "trace":
+        return _trace_cmd(args)
+    if args.cmd == "metrics":
+        return _metrics_cmd(args)
 
     if args.cmd == "report":
+        if args.trend:
+            if args.compare or args.paths:
+                print("obs report: --trend stands alone; drop --compare "
+                      "and positional files", file=sys.stderr)
+                return 2
+            return _trend_cmd(args)
         if args.compare and args.paths:
             print("obs report: --compare takes exactly two files; drop the "
                   "positional run file(s) or the flag", file=sys.stderr)
@@ -172,6 +235,155 @@ def _overlap_cmd(args) -> int:
             print(f"== {name}")
             print(format_ledger(ledger))
     return 0
+
+
+def _trace_cmd(args) -> int:
+    """``obs trace``: Chrome/Perfetto trace-event JSON of compiled engine
+    families (simulated wire, analytical, pipeline-tick lanes) and/or a
+    RunLog's measured lanes.  Same compile pattern as ``obs overlap``."""
+    from mpi4dl_tpu.obs.trace import (
+        chrome_trace,
+        hlo_trace_events,
+        trace_from_runlog,
+    )
+
+    if bool(args.hlo) and bool(args.families):
+        print("obs trace: --families and --hlo are mutually exclusive",
+              file=sys.stderr)
+        return 2
+    if not (args.hlo or args.families or args.runlog):
+        print("obs trace: need --families, --hlo, or --runlog",
+              file=sys.stderr)
+        return 2
+
+    events = []
+    if args.hlo:
+        try:
+            with open(args.hlo, "r", encoding="utf-8") as fh:
+                text = fh.read()
+        except OSError as e:
+            print(f"obs trace: cannot read {args.hlo}: {e}",
+                  file=sys.stderr)
+            return 2
+        import jax
+
+        events += hlo_trace_events(text, label=args.hlo,
+                                   device=jax.devices()[0])
+    elif args.families:
+        from mpi4dl_tpu.analysis.contracts.engines import (
+            _PARTS,
+            _STAGES,
+            ENGINE_FAMILIES,
+            build_engine,
+        )
+        from mpi4dl_tpu.analysis.contracts.extract import ensure_virtual_mesh
+
+        families = (
+            list(ENGINE_FAMILIES) if args.families == "all"
+            else [f.strip() for f in args.families.split(",") if f.strip()]
+        )
+        unknown = [f for f in families if f not in ENGINE_FAMILIES]
+        if unknown:
+            print(f"obs trace: unknown engine(s) {unknown}; "
+                  f"have {list(ENGINE_FAMILIES)}", file=sys.stderr)
+            return 2
+        err = ensure_virtual_mesh(families)
+        if err:
+            print(f"obs trace: {err}", file=sys.stderr)
+            return 2
+        import jax
+
+        # Bypass the persistent compilation cache: the trace lanes need the
+        # op_name scopes that cache hits strip (the obs/hbm.py caveat).
+        jax.config.update("jax_compilation_cache_dir", None)
+        for i, family in enumerate(families):
+            step, fargs = build_engine(family)
+            compiled = step.lower(*fargs).compile()
+            events += hlo_trace_events(
+                compiled.as_text(),
+                label=family,
+                device=jax.devices()[0],
+                schedule="1f1b" if family.endswith("_1f1b") else "gpipe",
+                stages=_STAGES,
+                parts=_PARTS,
+                pid_base=1 + i * 10,
+            )
+    if args.runlog:
+        from mpi4dl_tpu.obs.runlog import read_runlog
+
+        try:
+            records = read_runlog(args.runlog)
+        except OSError as e:
+            print(f"obs trace: cannot read {args.runlog}: {e}",
+                  file=sys.stderr)
+            return 2
+        events += trace_from_runlog(records, label=args.runlog)
+
+    with open(args.out, "w", encoding="utf-8") as fh:
+        json.dump(chrome_trace(events), fh)
+        fh.write("\n")
+    print(f"obs trace: wrote {len(events)} events to {args.out}")
+    return 0
+
+
+def _metrics_cmd(args) -> int:
+    """``obs metrics``: OpenMetrics exposition of one RunLog — stdout,
+    atomic file sink, and/or the stdlib HTTP endpoint."""
+    from mpi4dl_tpu.obs.metrics import (
+        metrics_from_runlog,
+        metrics_port_from_env,
+        serve_metrics,
+        write_metrics_file,
+    )
+    from mpi4dl_tpu.obs.runlog import read_runlog
+
+    try:
+        records = read_runlog(args.path)
+    except OSError as e:
+        print(f"obs metrics: cannot read {args.path}: {e}", file=sys.stderr)
+        return 2
+    if args.out:
+        write_metrics_file(records, args.out)
+        print(f"obs metrics: wrote {args.out}")
+    elif args.serve is None:
+        # stdout exposition (re-rendered so torn-line notes surface once)
+        sys.stdout.write(metrics_from_runlog(args.path))
+    if args.serve is not None:
+        port = args.serve if args.serve >= 0 else metrics_port_from_env()
+        if port is None:
+            print("obs metrics: --serve needs a PORT (or set "
+                  "MPI4DL_METRICS_PORT)", file=sys.stderr)
+            return 2
+        srv = serve_metrics(args.path, port)
+        host, bound = srv.server_address[0], srv.server_address[1]
+        print(f"obs metrics: serving http://{host}:{bound}/metrics "
+              "(Ctrl-C to stop)", file=sys.stderr)
+        try:
+            srv.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            srv.server_close()
+    return 0
+
+
+def _trend_cmd(args) -> int:
+    """``obs report --trend DIR``: trajectory + per-series regression gate
+    (obs/trend.py).  Exit 1 on a gated breach."""
+    from mpi4dl_tpu.obs.trend import format_trend, trend_report
+
+    if not os.path.isdir(args.trend):
+        print(f"obs report: --trend {args.trend}: not a directory",
+              file=sys.stderr)
+        return 2
+    trend = trend_report(args.trend, threshold_pct=args.threshold)
+    # Artifact before stdout — a truncated pipe must not cost CI the JSON.
+    if args.trend_out:
+        with open(args.trend_out, "w", encoding="utf-8") as fh:
+            json.dump(trend, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+    print(format_trend(trend))
+    return 1 if trend["breaches"] else 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,249 @@
+"""OpenMetrics/Prometheus text exposition over RunLog records.
+
+The fleet-telemetry half of the observability stack: any RunLog file (a
+live training leg's, a bench rung's, a supervisor's) renders to the
+OpenMetrics text format — step-latency quantiles (the same
+``_percentile`` interpolation as ``StepMeter.stats()`` and ``obs
+report``, so the scrape never disagrees with the report), throughput,
+per-device HBM watermark and skew, wire bytes per step split
+quantized/raw, and supervisor incident counters by failure class.
+
+Two sinks:
+
+- **file** — :func:`write_metrics_file` drops a ``metrics.prom`` snapshot
+  atomically next to the RunLog (benchmarks/common.py and bench.py write
+  one per run/rung; a node-exporter textfile collector or CI artifact
+  picks it up);
+- **endpoint** — :func:`serve_metrics` is a stdlib-only HTTP server whose
+  ``/metrics`` re-reads the RunLog per scrape (no new dependencies; the
+  ``MPI4DL_METRICS_PORT`` hatch is the CLI's default port).
+
+CLI: ``python -m mpi4dl_tpu.obs metrics run.jsonl [--out F] [--serve
+[PORT]]``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from mpi4dl_tpu.obs.runlog import read_runlog
+from mpi4dl_tpu.utils.misc import _percentile
+
+#: Default snapshot basename (next to the RunLog it summarizes).
+METRICS_BASENAME = "metrics.prom"
+
+#: Exposition content type (OpenMetrics; Prometheus scrapes it natively).
+CONTENT_TYPE = "application/openmetrics-text; version=1.0.0; charset=utf-8"
+
+_QUANTILES = (0.5, 0.9, 0.99)
+
+
+def metrics_port_from_env() -> Optional[int]:
+    """The ``MPI4DL_METRICS_PORT`` hatch as an int port, or None (unset or
+    unparsable — file-sink only)."""
+    raw = os.environ.get("MPI4DL_METRICS_PORT", "")
+    try:
+        return int(raw) if raw else None
+    except ValueError:
+        return None
+
+
+def _num(v: float) -> str:
+    """Float rendering that round-trips and never uses locale separators."""
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _esc(v: Any) -> str:
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _labels(labels: Optional[Dict[str, Any]]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{_esc(v)}"' for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+class _Exposition:
+    """Ordered OpenMetrics text builder (families declared once, samples
+    appended under them, ``# EOF`` terminator)."""
+
+    def __init__(self) -> None:
+        self.lines: List[str] = []
+
+    def family(self, name: str, mtype: str, help_text: str) -> None:
+        self.lines.append(f"# TYPE {name} {mtype}")
+        self.lines.append(f"# HELP {name} {help_text}")
+
+    def sample(self, name: str, value: float,
+               labels: Optional[Dict[str, Any]] = None) -> None:
+        self.lines.append(f"{name}{_labels(labels)} {_num(float(value))}")
+
+    def text(self) -> str:
+        return "\n".join(self.lines + ["# EOF"]) + "\n"
+
+
+def _measured_steps(records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    return [r for r in records
+            if r.get("kind") == "step" and r.get("measured", True)]
+
+
+def _wire_totals(
+    records: List[Dict[str, Any]],
+) -> Optional[Tuple[float, float]]:
+    """(total, quantized) wire bytes/step from ``overlap`` records — the
+    min-bytes row, matching the ``obs report --compare`` extractors."""
+    pairs = [
+        (float(t["bytes"]), float(t.get("quantized_bytes") or 0))
+        for r in records if r.get("kind") == "overlap"
+        for t in [r.get("totals") or {}] if t.get("bytes") is not None
+    ]
+    return min(pairs) if pairs else None
+
+
+def metrics_from_records(records: List[Dict[str, Any]],
+                         *, prefix: str = "mpi4dl") -> str:
+    """The OpenMetrics exposition of one record stream.  Families with no
+    source records are omitted (absent metric > lying zero), so the output
+    of a supervisor log and a bench log differ in families, not in junk."""
+    exp = _Exposition()
+    steps = _measured_steps(records)
+
+    if steps:
+        ms = sorted(float(r["ms"]) for r in steps)
+        name = f"{prefix}_step_latency_ms"
+        exp.family(name, "summary", "Measured optimizer-step wall time.")
+        for q in _QUANTILES:
+            exp.sample(name, _percentile(ms, q), {"quantile": _num(q)})
+        exp.sample(name + "_sum", sum(ms))
+        exp.sample(name + "_count", len(ms))
+
+        ips = [float(r["images_per_sec"]) for r in steps
+               if r.get("images_per_sec") is not None]
+        if ips:
+            name = f"{prefix}_images_per_sec"
+            exp.family(name, "gauge", "Mean measured throughput.")
+            exp.sample(name, sum(ips) / len(ips))
+
+        peaks = [int(r["memory_peak_bytes"]) for r in steps
+                 if r.get("memory_peak_bytes") is not None]
+        if peaks:
+            name = f"{prefix}_device_hbm_peak_bytes"
+            exp.family(name, "gauge",
+                       "Max per-device allocator watermark over the run.")
+            exp.sample(name, max(peaks))
+        skews = [int(r["hbm_skew"]) for r in steps
+                 if r.get("hbm_skew") is not None]
+        if skews:
+            name = f"{prefix}_device_hbm_skew_bytes"
+            exp.family(name, "gauge",
+                       "Max hot-vs-cold device watermark spread (SP "
+                       "imbalance shows here before the hot tile OOMs).")
+            exp.sample(name, max(skews))
+        rss = [int(r["host_rss_peak_bytes"]) for r in steps
+               if r.get("host_rss_peak_bytes") is not None]
+        if rss:
+            name = f"{prefix}_host_rss_peak_bytes"
+            exp.family(name, "gauge", "Peak host RSS over the run.")
+            exp.sample(name, max(rss))
+
+    wire = _wire_totals(records)
+    if wire is not None:
+        total, quant = wire
+        name = f"{prefix}_wire_bytes_per_step"
+        exp.family(name, "gauge",
+                   "Collective wire payload per step (overlap ledger; "
+                   "quantized = sub-f32 dtypes on the wire).")
+        exp.sample(name, total, {"kind": "total"})
+        exp.sample(name, quant, {"kind": "quantized"})
+        exp.sample(name, total - quant, {"kind": "raw"})
+
+    counts: Dict[str, int] = {}
+    for r in records:
+        if r.get("kind") in ("anomaly", "recovery", "preempt",
+                             "quarantine", "restore"):
+            counts[str(r["kind"])] = counts.get(str(r["kind"]), 0) + 1
+    if counts:
+        name = f"{prefix}_resilience_events"
+        exp.family(name, "counter",
+                   "Resilience events recorded by the supervised loop.")
+        for kind, n in sorted(counts.items()):
+            exp.sample(name + "_total", n, {"event": kind})
+
+    incidents: Dict[str, int] = {}
+    for r in records:
+        if r.get("kind") == "supervisor" and r.get("failure_class"):
+            cls = str(r["failure_class"])
+            incidents[cls] = incidents.get(cls, 0) + 1
+    if incidents:
+        name = f"{prefix}_supervisor_incidents"
+        exp.family(name, "counter",
+                   "Supervisor incidents by typed failure class.")
+        for cls, n in sorted(incidents.items()):
+            exp.sample(name + "_total", n, {"class": cls})
+    for r in records:
+        if r.get("kind") == "supervisor_summary":
+            name = f"{prefix}_supervisor_ok"
+            exp.family(name, "gauge",
+                       "1 = the supervised run completed, 0 = gave up.")
+            exp.sample(name, 1 if r.get("ok") else 0)
+            break
+
+    if steps:
+        name = f"{prefix}_steps"
+        exp.family(name, "counter", "Measured optimizer steps.")
+        exp.sample(name + "_total", len(steps))
+    return exp.text()
+
+
+def metrics_from_runlog(path: str, *, prefix: str = "mpi4dl") -> str:
+    return metrics_from_records(read_runlog(path), prefix=prefix)
+
+
+def write_metrics_file(records: List[Dict[str, Any]], path: str,
+                       *, prefix: str = "mpi4dl") -> str:
+    """Atomic snapshot write (tmp + replace — a concurrent textfile
+    collector never reads a half exposition).  Returns ``path``."""
+    text = metrics_from_records(records, prefix=prefix)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        fh.write(text)
+    os.replace(tmp, path)
+    return path
+
+
+def serve_metrics(runlog_path: str, port: int, *, host: str = "127.0.0.1",
+                  prefix: str = "mpi4dl"):
+    """A stdlib HTTP server whose ``/metrics`` re-reads ``runlog_path`` per
+    scrape.  Returns the server (caller owns ``serve_forever`` /
+    ``shutdown``; ``server_address[1]`` is the bound port — pass ``port=0``
+    for an ephemeral one in tests)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class _Handler(BaseHTTPRequestHandler):
+        def do_GET(self) -> None:  # noqa: N802 — stdlib API name
+            if self.path.split("?", 1)[0] not in ("/", "/metrics"):
+                self.send_error(404)
+                return
+            try:
+                body = metrics_from_runlog(
+                    runlog_path, prefix=prefix).encode("utf-8")
+            except OSError as e:
+                self.send_error(500, explain=str(e))
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, format: str, *args: Any) -> None:
+            pass  # scrape traffic must not spam the training job's stderr
+
+    return ThreadingHTTPServer((host, port), _Handler)

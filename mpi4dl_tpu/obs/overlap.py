@@ -134,6 +134,14 @@ class WireEvent:
     window_flops: float  # FLOPs scheduled inside the start..done window
     comp: str           # computation the collective was scheduled in
     quantized: bool = False  # sub-f32 wire payload (quant layer)
+    # Simulated-schedule timestamps (ms on the owning computation's local
+    # clock — obs/trace.py shifts call-site COPIES onto the caller's clock;
+    # the cached originals must never be mutated).  Defaulted so the
+    # structural goldens, which never read them, stay byte-identical.
+    issue_ms: float = 0.0   # device clock when the start issued
+    begin_ms: float = 0.0   # wire clock when the payload began moving
+    end_ms: float = 0.0     # wire clock when the payload finished
+    done_ms: float = 0.0    # device clock after the done's stall
 
 
 @dataclasses.dataclass
@@ -241,9 +249,12 @@ class _ScheduleWalker:
         events: List[WireEvent] = []
         pending: Dict[str, _Pending] = {}
 
-        def finish(p: _Pending, now: float) -> Tuple[float, float, float]:
-            """(wire_ms, hidden_ms, exposed_ms) of a pending transfer whose
-            done executes at device time ``now``; advances the wire clock."""
+        def finish(
+            p: _Pending, now: float
+        ) -> Tuple[float, float, float, float, float]:
+            """(wire_ms, hidden_ms, exposed_ms, begin, end) of a pending
+            transfer whose done executes at device time ``now``; advances
+            the wire clock."""
             nonlocal wire_free
             wire_ms = self._wire_ms(p.bytes)
             begin = max(p.issue_ms, wire_free)
@@ -251,7 +262,7 @@ class _ScheduleWalker:
             wire_free = end
             exposed = max(0.0, end - now)          # stall incl. queueing
             hidden = max(0.0, wire_ms - exposed)   # covered by the window
-            return wire_ms, hidden, exposed
+            return wire_ms, hidden, exposed, begin, end
 
         for ins in instrs:
             base = collective_base(ins.opcode)
@@ -282,18 +293,21 @@ class _ScheduleWalker:
                 if start is None:
                     continue
                 p = pending.pop(start)
-                wire_ms, hidden, exposed = finish(p, clock)
+                wire_ms, hidden, exposed, begin, end = finish(p, clock)
                 clock += exposed
                 events.append(WireEvent(
                     scope=p.scope, cls=p.cls, bytes=p.bytes,
                     wire_ms=wire_ms, hidden_ms=hidden, exposed_ms=exposed,
                     sync=False, window_flops=flops_acc - p.flops_at_issue,
                     comp=comp, quantized=p.quantized,
+                    issue_ms=p.issue_ms, begin_ms=begin, end_ms=end,
+                    done_ms=clock,
                 ))
             elif base:
                 # Sync collective: no split, the device sits on the whole
                 # transfer — structurally unhideable.
                 wire_ms = self._wire_ms(ins.bytes)
+                issue = clock
                 begin = max(clock, wire_free)
                 wire_free = begin + wire_ms
                 stall = wire_free - clock
@@ -303,6 +317,8 @@ class _ScheduleWalker:
                     wire_ms=wire_ms, hidden_ms=0.0, exposed_ms=stall,
                     sync=True, window_flops=0.0, comp=comp,
                     quantized=payload_quantized(ins),
+                    issue_ms=issue, begin_ms=begin, end_ms=wire_free,
+                    done_ms=clock,
                 ))
             elif ins.opcode in ("convolution", "dot"):
                 fl = instr_flops(ins, ins.raw)
@@ -318,9 +334,22 @@ class _ScheduleWalker:
                 # all-computations-once convention as hlo_scope_costs.
                 for callee in ins.callees:
                     sub = self.sim(callee)
+                    off = clock
                     clock += sub.duration_ms
                     flops_acc += sub.flops
-                    events.extend(sub.events)
+                    # Sub-sims are memoized and SHARED across call sites:
+                    # shift copies onto this caller's clock, never the
+                    # cached events themselves.
+                    events.extend(
+                        dataclasses.replace(
+                            e,
+                            issue_ms=e.issue_ms + off,
+                            begin_ms=e.begin_ms + off,
+                            end_ms=e.end_ms + off,
+                            done_ms=e.done_ms + off,
+                        )
+                        for e in sub.events
+                    )
             elif ins.callees and ins.opcode not in ASYNC_GLUE_OPS:
                 # reduce/sort/map bodies: FLOPs only (no collectives there).
                 # Async glue is excluded: an async-update's wrapped
@@ -333,13 +362,15 @@ class _ScheduleWalker:
         # Starts whose done never appeared: close them at the end of the
         # computation (the value must be ready before the computation ends).
         for name, p in pending.items():
-            wire_ms, hidden, exposed = finish(p, clock)
+            wire_ms, hidden, exposed, begin, end = finish(p, clock)
             clock += exposed
             events.append(WireEvent(
                 scope=p.scope, cls=p.cls, bytes=p.bytes, wire_ms=wire_ms,
                 hidden_ms=hidden, exposed_ms=exposed, sync=False,
                 window_flops=flops_acc - p.flops_at_issue, comp=comp,
                 quantized=p.quantized,
+                issue_ms=p.issue_ms, begin_ms=begin, end_ms=end,
+                done_ms=clock,
             ))
         return _CompSim(duration_ms=clock, flops=flops_acc, events=events)
 

@@ -181,6 +181,15 @@ def render_run(path: str) -> str:
     if dev_peaks:
         lines.append(f"memory watermark: {_fmt_bytes(max(dev_peaks))} "
                      "(device peak_bytes_in_use)")
+        skews = [r.get("hbm_skew") for r in steps
+                 if r.get("hbm_skew") is not None]
+        if skews:
+            # Hot-vs-cold device spread: SP imbalance shows here while the
+            # device-0 watermark still reads healthy.
+            lines.append(
+                f"hbm skew: {_fmt_bytes(max(skews))} max spread across "
+                "local devices (hot tile vs coldest)"
+            )
     elif rss_peaks:
         lines.append(f"memory watermark: {_fmt_bytes(max(rss_peaks))} "
                      "(host peak RSS; backend reports no device stats)")

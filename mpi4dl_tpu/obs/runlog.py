@@ -77,6 +77,33 @@ def device_memory_watermark(device=None) -> Optional[int]:
     return stats.get("peak_bytes_in_use") or stats.get("bytes_in_use")
 
 
+def device_memory_watermarks(devices=None) -> Optional[Dict[str, Any]]:
+    """Watermarks across ALL local devices — device 0 alone hides SP
+    imbalance (an unevenly sliced grid OOMs on the hot tile while device 0
+    reads healthy).  ``max``/``min``/``hbm_skew`` (max − min) plus the raw
+    ``per_device`` list; None where no device reports allocator stats."""
+    import jax
+
+    devs = devices if devices is not None else jax.local_devices()
+    peaks: List[int] = []
+    for dev in devs:
+        stats = getattr(dev, "memory_stats", lambda: None)()
+        if not stats:
+            continue
+        peak = stats.get("peak_bytes_in_use") or stats.get("bytes_in_use")
+        if peak:
+            peaks.append(int(peak))
+    if not peaks:
+        return None
+    return {
+        "max": max(peaks),
+        "min": min(peaks),
+        "hbm_skew": max(peaks) - min(peaks),
+        "devices": len(peaks),
+        "per_device": peaks,
+    }
+
+
 def host_rss_peak_bytes() -> Optional[int]:
     """Process peak RSS — the memory watermark that exists on every host,
     including CPU backends whose devices report no allocator stats."""
@@ -174,6 +201,7 @@ class RunLog:
                    **extra: Any) -> Dict[str, Any]:
         """One optimizer step.  ``measured=False`` marks warmup/compile steps
         (excluded from summary stats, kept in the record stream)."""
+        wm = device_memory_watermarks()
         return self.write(
             "step",
             epoch=epoch,
@@ -183,7 +211,9 @@ class RunLog:
             loss=float(loss),
             accuracy=float(accuracy),
             measured=bool(measured),
-            memory_peak_bytes=device_memory_watermark(),
+            memory_peak_bytes=None if wm is None else wm["max"],
+            memory_peak_bytes_min=None if wm is None else wm["min"],
+            hbm_skew=None if wm is None else wm["hbm_skew"],
             host_rss_peak_bytes=host_rss_peak_bytes(),
             jit_cache_size=jit_cache_size(step_fn) if step_fn is not None else None,
             **extra,
@@ -201,16 +231,22 @@ class RunLog:
 
 
 def read_runlog(path: str) -> List[Dict[str, Any]]:
-    """Parse one run file back into records (skipping malformed lines — a
-    crash can truncate the last line mid-write)."""
+    """Parse one run file back into records, skipping malformed lines with a
+    stderr note — a crashed leg truncates its last line mid-write, and the
+    report/trend tooling promises to render crashed-run files."""
+    import sys
+
     out: List[Dict[str, Any]] = []
     with open(path, "r", encoding="utf-8") as fh:
-        for line in fh:
+        for lineno, line in enumerate(fh, start=1):
             line = line.strip()
             if not line:
                 continue
             try:
                 out.append(json.loads(line))
             except json.JSONDecodeError:
+                sys.stderr.write(
+                    f"[obs] {path}:{lineno}: skipping torn record "
+                    f"({len(line)} bytes) — truncated mid-write?\n")
                 continue
     return out

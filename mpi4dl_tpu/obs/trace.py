@@ -1,0 +1,282 @@
+"""Chrome/Perfetto trace-event export of the analytic schedule + RunLogs.
+
+Everything the analytic stack knows about one compiled step — the overlap
+ledger's start/done wire windows (obs/overlap.py, now with simulated-clock
+timestamps on every :class:`~mpi4dl_tpu.obs.overlap.WireEvent`), the
+per-scope analytical timeline (obs/timeline.py), and the pipeline
+tick/bubble arithmetic — rendered as Trace Event Format JSON that loads
+directly in ``chrome://tracing`` / https://ui.perfetto.dev.  Plus
+:func:`trace_from_runlog`: the MEASURED step walls and resilience events of
+any RunLog file on the same timeline format, so a simulated schedule and a
+real run are inspectable side by side in the same viewer.
+
+Lanes (one Perfetto "process" per view, named via ``M`` metadata events):
+
+- ``schedule sim``: the simulated wire — one complete (``ph: X``) span per
+  collective transfer over its ``begin..end`` wire window, a ``device
+  stall`` lane for the exposed portion ending at the done, and ``s``/``f``
+  flow arrows tying each async start's issue to its done-side stall;
+- ``analytical``: per-scope serialized compute and wire spans (the
+  obs/timeline.py ranking, laid end to end);
+- ``pipeline``: per-stage tick lanes — busy ticks plus fill/drain bubble
+  spans from :func:`~mpi4dl_tpu.obs.timeline.pipeline_ticks` (a
+  *visualization* of the schedule arithmetic: stage ``s`` is drawn active
+  over ticks ``[s, ticks - (S-1-s))`` — exactly ``parts`` busy ticks under
+  GPipe; under 1F1B the window includes the steady-state fwd/bwd
+  alternation);
+- ``measured``: RunLog step records as wall-clock spans, with checkpoint
+  saves and anomaly/preempt/quarantine instants on an event lane.
+
+Timestamps are microseconds (the format's unit); simulated lanes sit on the
+walker's local clock, measured lanes on seconds-since-first-record.  CLI:
+``python -m mpi4dl_tpu.obs trace [--families lp,... | --runlog F] --out
+trace.json``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from mpi4dl_tpu.obs.costs import (
+    DEFAULT_ICI_BYTES_PER_S,
+    ici_bytes_per_s,
+    peak_flops,
+)
+from mpi4dl_tpu.obs.overlap import UNSCOPED, _events, wire_class
+from mpi4dl_tpu.obs.timeline import (
+    bubble_fraction,
+    hlo_scope_costs,
+    pipeline_ticks,
+)
+
+#: The trace-event container's display unit hint.
+DISPLAY_TIME_UNIT = "ms"
+
+
+def _us(ms: float) -> float:
+    """Walker/report milliseconds -> trace-event microseconds."""
+    return round(ms * 1000.0, 3)
+
+
+def _span(name: str, pid: int, tid: int, ts_ms: float, dur_ms: float,
+          cat: str, args: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    ev: Dict[str, Any] = {
+        "name": name, "ph": "X", "cat": cat, "pid": pid, "tid": tid,
+        "ts": _us(ts_ms), "dur": max(_us(dur_ms), 0.0),
+    }
+    if args:
+        ev["args"] = args
+    return ev
+
+
+def _instant(name: str, pid: int, tid: int, ts_ms: float, cat: str,
+             args: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    ev: Dict[str, Any] = {
+        "name": name, "ph": "i", "s": "t", "cat": cat, "pid": pid,
+        "tid": tid, "ts": _us(ts_ms),
+    }
+    if args:
+        ev["args"] = args
+    return ev
+
+
+def _meta(pid: int, process: Optional[str] = None, tid: int = 0,
+          thread: Optional[str] = None) -> Dict[str, Any]:
+    if process is not None:
+        return {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                "args": {"name": process}}
+    return {"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+            "args": {"name": thread or ""}}
+
+
+def _resolve_rates(peak: Optional[float], ici_bw: Optional[float],
+                   device) -> Tuple[Optional[float], float]:
+    """Same device-derived defaulting as overlap_ledger /
+    analytical_timeline (CPU hosts get the labeled nominal constants)."""
+    if peak is None and device is not None:
+        peak, _ = peak_flops(device, allow_cpu_nominal=True)
+    if ici_bw is None:
+        if device is not None:
+            ici_bw, _ = ici_bytes_per_s(device)
+        else:
+            ici_bw = DEFAULT_ICI_BYTES_PER_S
+    return peak, float(ici_bw or 0.0)
+
+
+def hlo_trace_events(
+    hlo_text: str,
+    *,
+    label: str = "step",
+    peak: Optional[float] = None,
+    ici_bw: Optional[float] = None,
+    device=None,
+    schedule: Optional[str] = None,
+    stages: Optional[int] = None,
+    parts: Optional[int] = None,
+    pid_base: int = 1,
+) -> List[Dict[str, Any]]:
+    """Trace events for one compiled module: simulated wire lane, analytical
+    per-scope lanes, and (with ``schedule``/``stages``/``parts``) per-stage
+    pipeline tick lanes.  ``pid_base`` spaces multiple modules — each module
+    occupies pids ``pid_base .. pid_base+2``."""
+    peak, ici_bw = _resolve_rates(peak, ici_bw, device)
+    events, sim = _events(hlo_text, peak, ici_bw)
+    sim_pid, ana_pid, pipe_pid = pid_base, pid_base + 1, pid_base + 2
+
+    out: List[Dict[str, Any]] = [
+        _meta(sim_pid, process=f"schedule sim [{label}]"),
+        _meta(sim_pid, tid=0, thread="wire"),
+        _meta(sim_pid, tid=1, thread="device stalls"),
+    ]
+    flow_id = 0
+    for e in events:
+        scope = e.scope or UNSCOPED
+        out.append(_span(
+            f"{e.cls} {scope}", sim_pid, 0, e.begin_ms, e.wire_ms, "wire",
+            args={
+                "bytes": e.bytes, "wire_ms": round(e.wire_ms, 4),
+                "hidden_ms": round(e.hidden_ms, 4),
+                "exposed_ms": round(e.exposed_ms, 4),
+                "sync": e.sync, "quantized": e.quantized,
+                "wire_class": wire_class(e.scope, e.cls), "comp": e.comp,
+            },
+        ))
+        if e.exposed_ms > 0:
+            out.append(_span(
+                f"stall {e.cls} {scope}", sim_pid, 1,
+                e.done_ms - e.exposed_ms, e.exposed_ms, "stall",
+                args={"bytes": e.bytes, "sync": e.sync},
+            ))
+        if not e.sync:
+            # Flow arrow: the async start's issue point to its done-side
+            # landing — the visual "this window hides that transfer".
+            flow_id += 1
+            common = {"cat": "wire-flow", "name": f"{e.cls} {scope}",
+                      "id": flow_id, "pid": sim_pid}
+            out.append({**common, "ph": "s", "tid": 0,
+                        "ts": _us(e.begin_ms)})
+            out.append({**common, "ph": "f", "bp": "e", "tid": 1,
+                        "ts": _us(e.done_ms)})
+
+    # -- analytical per-scope lanes (serialized, laid end to end) ----------
+    out.append(_meta(ana_pid, process=f"analytical [{label}]"))
+    out.append(_meta(ana_pid, tid=0, thread="compute (serialized)"))
+    out.append(_meta(ana_pid, tid=1, thread="wire (serialized)"))
+    costs = hlo_scope_costs(hlo_text)
+    rows = sorted(
+        costs.items(),
+        key=lambda kv: -(kv[1]["flops"] + kv[1]["collective_bytes"]),
+    )
+    comp_t = wire_t = 0.0
+    for scope, c in rows:
+        name = scope or UNSCOPED
+        if c["flops"] and peak:
+            dur = c["flops"] / peak * 1e3
+            out.append(_span(name, ana_pid, 0, comp_t, dur, "compute",
+                             args={"flops": c["flops"]}))
+            comp_t += dur
+        if c["collective_bytes"] and ici_bw:
+            dur = c["collective_bytes"] / ici_bw * 1e3
+            out.append(_span(
+                name, ana_pid, 1, wire_t, dur, "wire",
+                args={"bytes": int(c["collective_bytes"]),
+                      "count": int(c["collective_count"])},
+            ))
+            wire_t += dur
+
+    # -- pipeline tick lanes -----------------------------------------------
+    ticks = (pipeline_ticks(schedule, stages, parts)
+             if schedule and stages and parts else None)
+    if ticks is not None and stages and parts:
+        bubble = bubble_fraction(schedule or "", stages, parts)
+        # Share the simulated step's time scale so the lanes line up with
+        # the wire lane; an all-zero-cost module still gets unit ticks.
+        tick_ms = (sim.duration_ms / parts) if sim.duration_ms > 0 else 1.0
+        out.append(_meta(pipe_pid, process=f"pipeline [{label}]"))
+        for s in range(stages):
+            out.append(_meta(pipe_pid, tid=s, thread=f"stage {s}"))
+            head, tail = s, stages - 1 - s
+            if head:
+                out.append(_span("bubble (fill)", pipe_pid, s, 0.0,
+                                 head * tick_ms, "bubble"))
+            for t in range(head, ticks - tail):
+                name = (f"mb{t - head}" if schedule == "gpipe"
+                        else f"tick {t}")
+                out.append(_span(
+                    name, pipe_pid, s, t * tick_ms, tick_ms, "tick",
+                    args={"schedule": schedule, "tick": t,
+                          "bubble_fraction": bubble},
+                ))
+            if tail:
+                out.append(_span("bubble (drain)", pipe_pid, s,
+                                 (ticks - tail) * tick_ms, tail * tick_ms,
+                                 "bubble"))
+    return out
+
+
+#: RunLog record kinds rendered as instants on the measured event lane.
+_RUNLOG_INSTANTS = (
+    "anomaly", "recovery", "preempt", "quarantine", "restore", "drill",
+    "supervisor",
+)
+
+
+def trace_from_runlog(
+    records: List[Dict[str, Any]],
+    *,
+    label: str = "run",
+    pid_base: int = 90,
+) -> List[Dict[str, Any]]:
+    """Measured lanes from RunLog records: step walls as spans (ended at
+    the record's write time, so the span is the step's real wall window),
+    checkpoint saves as gather+write spans, resilience/supervisor events as
+    instants.  Timeline zero is the file's first record."""
+    ts = [float(r["t"]) for r in records if r.get("t") is not None]
+    if not ts:
+        return []
+    t0 = min(ts)
+    pid = pid_base
+    out: List[Dict[str, Any]] = [
+        _meta(pid, process=f"measured [{label}]"),
+        _meta(pid, tid=0, thread="steps"),
+        _meta(pid, tid=1, thread="checkpoints"),
+        _meta(pid, tid=2, thread="events"),
+    ]
+    for r in records:
+        kind, t = r.get("kind"), r.get("t")
+        if t is None:
+            continue
+        end_ms = (float(t) - t0) * 1e3
+        if kind == "step" and r.get("ms") is not None:
+            dur = float(r["ms"])
+            out.append(_span(
+                f"step e{r.get('epoch', '?')}:{r.get('step', '?')}",
+                pid, 0, max(end_ms - dur, 0.0), dur, "step",
+                args={k: r.get(k) for k in (
+                    "loss", "images_per_sec", "measured",
+                    "memory_peak_bytes", "hbm_skew", "jit_cache_size",
+                ) if r.get(k) is not None},
+            ))
+        elif kind == "checkpoint":
+            dur = (float(r.get("gather_ms") or 0.0)
+                   + float(r.get("write_ms") or 0.0))
+            out.append(_span(
+                f"checkpoint {r.get('step_id', '?')}", pid, 1,
+                max(end_ms - dur, 0.0), dur, "checkpoint",
+                args={k: r.get(k) for k in (
+                    "bytes", "gather_ms", "write_ms", "peak_pending_bytes",
+                ) if r.get(k) is not None},
+            ))
+        elif kind in _RUNLOG_INSTANTS:
+            detail = (r.get("reason") or r.get("failure_class")
+                      or r.get("scenario") or "")
+            name = f"{kind} {detail}".strip()
+            out.append(_instant(name, pid, 2, end_ms, "event",
+                                args={"gstep": r.get("gstep")}))
+    return out
+
+
+def chrome_trace(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Wrap event lists into the JSON-object trace container the viewers
+    load (``displayTimeUnit`` is a hint; timestamps stay microseconds)."""
+    return {"traceEvents": events, "displayTimeUnit": DISPLAY_TIME_UNIT}

@@ -107,6 +107,17 @@ class AnomalyGuard:
                     )
         return None
 
+    def snapshot(self) -> Dict[str, Any]:
+        """The guard's current posture, for the flight recorder's anomaly
+        ring entry — how close to the fail-fast this anomaly landed."""
+        return {
+            "rollbacks": self.rollbacks,
+            "max_rollbacks": self.max_rollbacks,
+            "good_streak": self.good_streak,
+            "rollback_decay_steps": self.rollback_decay_steps,
+            "grad_norm_limit": self.grad_norm_limit,
+        }
+
     def note_rollback(self) -> None:
         self.rollbacks += 1
         if self.rollbacks > self.max_rollbacks:

@@ -102,6 +102,7 @@ def run_supervised(
     retries: int = 2,
     retry_backoff: float = 0.05,
     snapshot_rollback: bool = False,
+    flight=None,
 ) -> LoopResult:
     """Run ``steps_per_epoch * num_epochs`` supervised steps from
     ``start_step``; returns the final state plus what happened.
@@ -133,11 +134,30 @@ def run_supervised(
     quarantine = quarantine_steps_from_env()
     phase = "init"
 
+    # Flight recorder (ISSUE 17): the always-on in-memory forensic ring
+    # every leg runs by default.  Dumps land next to the crash marker when
+    # the supervisor set one (its per-attempt directory), else next to the
+    # RunLog; no destination = ring only (the watchdog still reads its tail).
+    from mpi4dl_tpu.obs.flight import (
+        FLIGHT_BASENAME,
+        FlightRecorder,
+        default_flight_path,
+    )
+
+    if flight is None:
+        fpath = default_flight_path()
+        if fpath is None and runlog is not None and getattr(runlog, "path", None):
+            fpath = os.path.join(
+                os.path.dirname(os.path.abspath(runlog.path)), FLIGHT_BASENAME)
+        flight = FlightRecorder.from_env(path=fpath)
+
     def _ckpt_record(stats) -> None:
         """Emit the ``checkpoint`` RunLog record (worker thread for async
         saves, training thread for sync ones — RunLog.write is locked)."""
         if runlog is not None and stats is not None:
             runlog.write("checkpoint", **stats.record())
+        if flight is not None and stats is not None:
+            flight.note("checkpoint", **stats.record())
 
     writer = (
         AsyncCheckpointWriter(ckpt, on_saved=_ckpt_record)
@@ -187,21 +207,30 @@ def run_supervised(
     def _wd_context():
         """Stall-dump context: the last record of any kind PLUS the last
         ``checkpoint`` record, so a stall inside the shard-gather is
-        distinguishable from a data stall."""
-        if runlog is None:
+        distinguishable from a data stall — and the flight-recorder tail,
+        the trajectory leading into the stall."""
+        if runlog is None and flight is None:
             return None
-        return {
-            "last": getattr(runlog, "last_record", None),
-            "last_checkpoint": getattr(runlog, "last_by_kind", {}).get(
-                "checkpoint"
-            ),
+        ctx = {
+            "last": getattr(runlog, "last_record", None)
+            if runlog is not None else None,
+            "last_checkpoint": (getattr(runlog, "last_by_kind", {}).get(
+                "checkpoint") if runlog is not None else None),
         }
+        if flight is not None:
+            ctx["flight_tail"] = flight.tail(5)
+        return ctx
 
     def _escalate(label: str) -> None:
         """Watchdog escalation: the straggler never finished — leave a
         typed ``hang`` marker and exit the leg so the supervisor can
         classify and relaunch.  ``os._exit`` is deliberate: the training
         thread is wedged inside the very call we are escalating out of."""
+        if flight is not None:
+            # `phase` says WHERE the leg is wedged (fetch = data stall,
+            # step = collective, save = checkpoint gather) — the evidence
+            # the supervisor uses to split the hang classes.
+            flight.dump("watchdog_escalation", phase=phase, gstep=gstep)
         if marker_path:
             write_crash_marker(
                 marker_path, phase="step", gstep=gstep,
@@ -219,8 +248,14 @@ def run_supervised(
         escalate_after=escalate_n,
         on_escalate=_escalate if escalate_n > 0 else None,
     )
+    _on_signal = (
+        (lambda signum: flight.note("preempt_signal", signum=signum,
+                                    gstep=gstep))
+        if flight is not None else None
+    )
     preempt = (
-        PreemptionHandler() if handle_signals else PreemptionHandler(())
+        PreemptionHandler(on_signal=_on_signal) if handle_signals
+        else PreemptionHandler((), on_signal=_on_signal)
     )
 
     def _preempt_exit(st: Any, step_id: int) -> None:
@@ -230,6 +265,10 @@ def run_supervised(
         if runlog is not None:
             runlog.write("preempt", gstep=step_id, signum=preempt.signum,
                          saved=saved)
+        if flight is not None:
+            flight.note("preempt", gstep=step_id, signum=preempt.signum,
+                        saved=saved)
+            flight.dump("preemption", phase=phase, gstep=step_id)
         emit(
             f"preemption signal {preempt.signum} — "
             + (f"checkpoint saved at step {step_id}"
@@ -287,6 +326,9 @@ def run_supervised(
                             if runlog is not None:
                                 runlog.write("quarantine", gstep=g,
                                              epoch=epoch, step=i)
+                            if flight is not None:
+                                flight.note("quarantine", gstep=g,
+                                            epoch=epoch, step=i)
                             gstep = g + 1
                             if gstep % steps_per_epoch == 0:
                                 _boundary_save(state, gstep)
@@ -314,6 +356,12 @@ def run_supervised(
                                     "anomaly", gstep=g, epoch=epoch, step=i,
                                     loss=loss, reason=reason,
                                 )
+                            if flight is not None:
+                                flight.note("anomaly", gstep=g, epoch=epoch,
+                                            step=i, loss=loss, reason=reason,
+                                            guard=guard.snapshot()
+                                            if guard is not None else None)
+                                flight.dump("anomaly", phase="step", gstep=g)
                             emit(f"anomaly at step {g}: {reason}")
                             if ckpt is None and snapshot is None:
                                 # detection-only: no rollback target exists
@@ -367,6 +415,12 @@ def run_supervised(
                                 loss=loss, accuracy=acc, step_fn=step_fn,
                                 measured=measured, gstep=g,
                             )
+                        if flight is not None:
+                            flight.note_step(
+                                gstep=g, phase=phase, step_fn=step_fn,
+                                epoch=epoch, step=i, ms=round(ms, 3),
+                                loss=loss,
+                            )
                         gstep = g + 1
                         steps_run += 1
 
@@ -393,6 +447,10 @@ def run_supervised(
         # written BEFORE the exception propagates so the supervisor can
         # classify this death even if the interpreter never unwinds
         # further.  write_crash_marker itself never raises.
+        if flight is not None:
+            flight.note("crash", error_type=type(e).__name__,
+                        error=str(e)[:500], phase=phase, gstep=gstep)
+            flight.dump("crash", phase=phase, gstep=gstep)
         if marker_path:
             extra = {}
             spec = getattr(e, "spec", None)

@@ -17,14 +17,21 @@ from __future__ import annotations
 
 import os
 import signal
-from typing import Dict, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 
 class PreemptionHandler:
-    """Context manager latching SIGTERM/SIGINT into a ``requested`` flag."""
+    """Context manager latching SIGTERM/SIGINT into a ``requested`` flag.
 
-    def __init__(self, signums: Tuple[int, ...] = (signal.SIGTERM, signal.SIGINT)):
+    ``on_signal`` (optional) fires once when the FIRST signal latches —
+    inside the signal handler, so it must be async-signal-tolerant (the
+    flight recorder's in-memory note qualifies; anything blocking does not).
+    """
+
+    def __init__(self, signums: Tuple[int, ...] = (signal.SIGTERM, signal.SIGINT),
+                 on_signal: Optional[Callable[[int], None]] = None):
         self.signums = tuple(signums)
+        self.on_signal = on_signal
         self.requested = False
         self.signum: Optional[int] = None
         self.active = False
@@ -51,6 +58,11 @@ class PreemptionHandler:
             return
         self.requested = True
         self.signum = signum
+        if self.on_signal is not None:
+            try:
+                self.on_signal(signum)
+            except Exception:  # noqa: BLE001  # analysis: ok(swallow-except)
+                pass  # deliberate: a notify hook must not break the latch
 
     def _restore(self) -> None:
         for s, h in self._old.items():

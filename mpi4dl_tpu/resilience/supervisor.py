@@ -167,11 +167,23 @@ def _anomaly_steps(records: Sequence[Mapping[str, Any]]) -> List[int]:
     })
 
 
+# Flight-recorder phase -> where a hang actually sits (ISSUE 17): the
+# recorder's last known phase at escalation time distinguishes the stalls
+# the exit status alone cannot.
+_HANG_SITES = {
+    "fetch": "data_stall",
+    "step": "collective",
+    "compile": "collective",
+    "save": "checkpoint_gather",
+}
+
+
 def classify_failure(
     exit_code: Optional[int],
     marker: Optional[Mapping[str, Any]] = None,
     records: Sequence[Mapping[str, Any]] = (),
     stderr_tail: str = "",
+    flight: Optional[Mapping[str, Any]] = None,
 ) -> "Classification":
     """Map one leg exit onto the typed taxonomy.
 
@@ -179,8 +191,37 @@ def classify_failure(
     watchdog's ``hang``, the mesh faults) wins; then the marker's error
     analysis (type family + phase); then the exit status (SIGKILL/escalation
     exit = hang, SIGTERM = preempted); then stderr/RunLog-tail pattern
-    matches; then ``unknown`` — never untyped, never silent."""
+    matches; then ``unknown`` — never untyped, never silent.
+
+    ``flight`` (the leg's ``flight.json`` dump, ISSUE 17) is the fourth
+    evidence source: it refines rather than decides — a hang gains a
+    ``hang_site`` (data stall vs collective vs checkpoint gather, from the
+    recorder's phase at escalation), an ``oom_step`` gains the watermark
+    growth + fastest-growing device from the ring, and the
+    oom_compile/oom_step split survives a leg whose RunLog never made it
+    back (the recorder's ``steps_seen`` says whether the first step ever
+    completed)."""
+    from mpi4dl_tpu.obs.flight import flight_summary, watermark_growth
+
     ev: Dict[str, Any] = {"exit_code": exit_code}
+    fsum = flight_summary(flight)
+    if fsum is not None:
+        ev["flight"] = fsum
+
+    def _hang_site() -> Optional[str]:
+        if not flight:
+            return None
+        return _HANG_SITES.get(str(flight.get("phase") or ""))
+
+    def _oom_localize() -> None:
+        if not flight:
+            return
+        growth = watermark_growth(dict(flight))
+        if growth is not None:
+            ev["oom_watermark_growth_bytes"] = growth[0]
+            if growth[1] is not None:
+                ev["oom_device"] = growth[1]
+
     if marker:
         ev.update({
             "marker_phase": marker.get("phase"),
@@ -190,6 +231,12 @@ def classify_failure(
         explicit = marker.get("failure_class")
         if explicit in FAILURE_CLASSES:
             ev["source"] = "marker:explicit"
+            if explicit == "hang":
+                site = _hang_site()
+                if site:
+                    ev["hang_site"] = site
+            if explicit == "oom_step":
+                _oom_localize()
             return Classification(explicit, ev)
         err = str(marker.get("error") or "")
         etype = marker.get("error_type") or ""
@@ -204,6 +251,8 @@ def classify_failure(
                 "oom_compile" if marker.get("phase") == "compile"
                 else "oom_step"
             )
+            if cls == "oom_step":
+                _oom_localize()
             return Classification(cls, ev)
         if etype == "AnomalyError":
             ev["source"] = "marker:error_type"
@@ -222,6 +271,9 @@ def classify_failure(
 
         if exit_code == HANG_EXIT_CODE or exit_code == -_signal.SIGKILL:
             ev["source"] = "exit_code"
+            site = _hang_site()
+            if site:
+                ev["hang_site"] = site
             return Classification("hang", ev)
         if exit_code == -_signal.SIGTERM:
             # killed before the grace-window save finished — still a
@@ -231,12 +283,14 @@ def classify_failure(
     if any(p in stderr_tail for p in _OOM_PATTERNS):
         ev["source"] = "stderr:oom_pattern"
         # no marker phase to split on: a leg that died during its first
-        # step never wrote a step record
-        cls = (
-            "oom_compile"
-            if not any(r.get("kind") == "step" for r in records)
-            else "oom_step"
-        )
+        # step never wrote a step record.  The flight recorder's
+        # steps_seen covers the case where the RunLog itself was lost.
+        stepped = any(r.get("kind") == "step" for r in records)
+        if not stepped and flight:
+            stepped = int(flight.get("steps_seen") or 0) > 0
+        cls = "oom_step" if stepped else "oom_compile"
+        if cls == "oom_step":
+            _oom_localize()
         return Classification(cls, ev)
     n_anomalies = sum(1 for r in records if r.get("kind") == "anomaly")
     n_recoveries = sum(1 for r in records if r.get("kind") == "recovery")
@@ -342,6 +396,9 @@ class LegOutcome:
     marker: Optional[Dict[str, Any]] = None
     records: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
     stderr_tail: str = ""
+    # The leg's flight.json dump (ISSUE 17) — the fourth evidence source;
+    # None when the leg exited cleanly or the recorder was disabled.
+    flight: Optional[Dict[str, Any]] = None
 
 
 def flags_to_argv(flags: Mapping[str, Any]) -> List[str]:
@@ -435,12 +492,15 @@ def subprocess_leg_launcher(
                 tail = f.read()
         except OSError:
             tail = ""
+        from mpi4dl_tpu.obs.flight import FLIGHT_BASENAME, read_flight
+
         out = LegOutcome(
             rc=rc if rc is not None else HANG_EXIT_CODE,
             result=result,
             marker=read_crash_marker(marker),
             records=_leg_runlog_records(tele),
             stderr_tail=tail,
+            flight=read_flight(os.path.join(adir, FLIGHT_BASENAME)),
         )
         return out
 
@@ -589,7 +649,7 @@ class Supervisor:
                 )
             else:
                 cls = classify_failure(out.rc, out.marker, out.records,
-                                       out.stderr_tail)
+                                       out.stderr_tail, out.flight)
             policy = POLICIES[cls.failure_class]
             per_class[cls.failure_class] = (
                 per_class.get(cls.failure_class, 0) + 1

@@ -254,8 +254,18 @@ class StepWatchdog:
             # checkpoint record; plain records render on one line.
             if isinstance(ctx, dict) and "last" in ctx:
                 for key, rec in ctx.items():
-                    if rec is not None:
-                        out.write(f"{key} runlog record: {json.dumps(rec)}\n")
+                    if rec is None:
+                        continue
+                    if key == "flight_tail" and isinstance(rec, list):
+                        # The flight recorder's last ring entries — the
+                        # trajectory INTO the stall, one JSON line each.
+                        out.write(
+                            f"flight tail ({len(rec)} ring entries, "
+                            "oldest first):\n")
+                        for entry in rec:
+                            out.write(f"  flight: {json.dumps(entry)}\n")
+                        continue
+                    out.write(f"{key} runlog record: {json.dumps(rec)}\n")
             elif ctx is not None:
                 rendered = (
                     json.dumps(ctx) if isinstance(ctx, dict) else str(ctx)

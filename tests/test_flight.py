@@ -1,0 +1,345 @@
+"""Flight recorder (ISSUE 17): ring semantics, dump triggers, evidence.
+
+The recorder is the supervisor's fourth evidence source, so the tests
+cover the full chain: ring overflow past capacity, dump-on-anomaly
+through the real training loop, the watchdog stall dump's flight tail,
+and ``classify_failure`` consuming a flight dict (hang-site
+disambiguation, oom_step localization, steps_seen tie-break), plus the
+env hatches (``MPI4DL_NO_FLIGHT``, ``MPI4DL_FLIGHT_STEPS``).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from mpi4dl_tpu.obs.flight import (
+    DEFAULT_FLIGHT_STEPS,
+    FLIGHT_BASENAME,
+    FlightRecorder,
+    default_flight_path,
+    flight_steps_from_env,
+    flight_summary,
+    read_flight,
+    watermark_growth,
+)
+from mpi4dl_tpu.resilience import (
+    AnomalyGuard,
+    FaultInjector,
+    FaultSpec,
+    StepWatchdog,
+    run_supervised,
+)
+from mpi4dl_tpu.resilience.supervisor import (
+    HANG_EXIT_CODE,
+    LegOutcome,
+    Supervisor,
+    classify_failure,
+)
+from test_resilience import _ToyDataset, _toy_state, _toy_step
+
+
+# ---------------------------------------------------------------------------
+# Ring semantics
+# ---------------------------------------------------------------------------
+
+
+def test_ring_overflow_keeps_newest():
+    rec = FlightRecorder(capacity=3)
+    for g in range(7):
+        rec.note_step(gstep=g, loss=float(g))
+    assert rec.steps_seen == 7
+    tail = rec.tail(10)
+    assert [e["gstep"] for e in tail] == [4, 5, 6]  # oldest-first, capped
+    assert rec.tail(2) == tail[-2:]
+
+
+def test_non_step_events_share_the_ring_and_land_in_last_events():
+    rec = FlightRecorder(capacity=4)
+    rec.note_step(gstep=0)
+    rec.note("checkpoint", gstep=2, gather_ms=5.0)
+    rec.note("anomaly", gstep=3, reason="nan loss")
+    snap = rec.snapshot("probe", "step", 3)
+    kinds = [e["kind"] for e in snap["ring"]]
+    assert kinds == ["step", "checkpoint", "anomaly"]
+    assert snap["last_events"]["checkpoint"]["gather_ms"] == 5.0
+    assert snap["last_events"]["anomaly"]["reason"] == "nan loss"
+    assert snap["reason"] == "probe" and snap["gstep"] == 3
+
+
+def test_step_records_capture_watermarks_and_jit_probe():
+    rec = FlightRecorder(capacity=4)
+    rec.note_step(gstep=0, phase="step")
+    entry = rec.tail(1)[0]
+    # On any backend the probe fields exist (values may be None on hosts
+    # without per-device memory stats).
+    for key in ("memory_peak_bytes", "hbm_skew", "host_rss_peak_bytes",
+                "jit_cache_size"):
+        assert key in entry
+
+
+def test_dump_and_read_back(tmp_path):
+    p = str(tmp_path / "flight.json")
+    rec = FlightRecorder(capacity=4, path=p)
+    rec.note_step(gstep=0, loss=1.0)
+    rec.note("anomaly", gstep=1, reason="nan loss")
+    out = rec.dump("anomaly", phase="step", gstep=1)
+    assert out == p
+    doc = read_flight(p)
+    assert doc is not None and doc["schema"] == 1
+    assert doc["reason"] == "anomaly" and doc["gstep"] == 1
+    assert [e["kind"] for e in doc["ring"]] == ["step", "anomaly"]
+    assert doc["steps_seen"] == 1 and doc["dumps"] == ["anomaly"]
+    # second dump appends to the dump history in the artifact
+    rec.dump("crash", phase="step", gstep=2)
+    assert read_flight(p)["dumps"] == ["anomaly", "crash"]
+
+
+def test_dump_never_raises(tmp_path):
+    (tmp_path / "blocker").write_text("not a directory")
+    rec = FlightRecorder(
+        capacity=2, path=str(tmp_path / "blocker" / "flight.json"))
+    rec.note_step(gstep=0)
+    assert rec.dump("crash") is None  # unwritable path -> None, no raise
+    assert read_flight(str(tmp_path / "missing.json")) is None
+
+
+# ---------------------------------------------------------------------------
+# Env hatches
+# ---------------------------------------------------------------------------
+
+
+def test_no_flight_hatch_disables(monkeypatch):
+    monkeypatch.setenv("MPI4DL_NO_FLIGHT", "1")
+    assert FlightRecorder.from_env() is None
+    monkeypatch.setenv("MPI4DL_NO_FLIGHT", "0")
+    assert isinstance(FlightRecorder.from_env(), FlightRecorder)
+
+
+def test_flight_steps_env_clamped(monkeypatch):
+    monkeypatch.delenv("MPI4DL_FLIGHT_STEPS", raising=False)
+    assert flight_steps_from_env() == DEFAULT_FLIGHT_STEPS
+    monkeypatch.setenv("MPI4DL_FLIGHT_STEPS", "8")
+    assert flight_steps_from_env() == 8
+    monkeypatch.setenv("MPI4DL_FLIGHT_STEPS", "0")
+    assert flight_steps_from_env() == 1  # clamped to a usable ring
+    monkeypatch.setenv("MPI4DL_FLIGHT_STEPS", "junk")
+    assert flight_steps_from_env() == DEFAULT_FLIGHT_STEPS
+
+
+def test_default_flight_path_follows_crash_marker(tmp_path, monkeypatch):
+    monkeypatch.delenv("MPI4DL_CRASH_MARKER", raising=False)
+    assert default_flight_path() is None
+    monkeypatch.setenv("MPI4DL_CRASH_MARKER", str(tmp_path / "m.json"))
+    assert default_flight_path() == str(tmp_path / FLIGHT_BASENAME)
+
+
+# ---------------------------------------------------------------------------
+# Loop integration: dump on anomaly / crash
+# ---------------------------------------------------------------------------
+
+
+def test_loop_dumps_flight_on_anomaly(tmp_path):
+    p = str(tmp_path / "flight.json")
+    flight = FlightRecorder(capacity=8, path=p)
+    res = run_supervised(
+        _toy_step(), _toy_state(), _ToyDataset(),
+        global_batch=8, steps_per_epoch=4, num_epochs=1,
+        faults=FaultInjector(FaultSpec("nan_loss", 2)),
+        guard=AnomalyGuard(), snapshot_rollback=True, flight=flight,
+    )
+    assert res.anomalies == 1 and res.final_step == 4
+    doc = read_flight(p)
+    assert doc is not None and doc["reason"] == "anomaly"
+    assert doc["gstep"] == 2 and doc["phase"] == "step"
+    kinds = [e["kind"] for e in doc["ring"]]
+    assert "anomaly" in kinds and "step" in kinds
+    anomaly = doc["last_events"]["anomaly"]
+    assert anomaly["reason"].startswith("non-finite") or anomaly["reason"]
+    assert anomaly["guard"]["max_rollbacks"] >= 1
+    # the run continued past the dump: the live ring has steps 0,1,3
+    assert doc["steps_seen"] == 2  # steps 0 and 1 at dump time
+
+
+def test_loop_dumps_flight_on_crash_before_marker(tmp_path):
+    class _Boom:
+        def batch(self, idx, batch_size):
+            raise RuntimeError("dataset exploded")
+
+    p = str(tmp_path / "flight.json")
+    with pytest.raises(RuntimeError, match="dataset exploded"):
+        run_supervised(
+            _toy_step(), _toy_state(), _Boom(),
+            global_batch=8, steps_per_epoch=2, num_epochs=1,
+            flight=FlightRecorder(capacity=4, path=p),
+        )
+    doc = read_flight(p)
+    assert doc is not None and doc["reason"] == "crash"
+    crash = doc["last_events"]["crash"]
+    assert crash["error_type"] == "RuntimeError"
+    assert "dataset exploded" in crash["error"]
+    assert doc["phase"] == "fetch"  # died in the fetch, not the step
+
+
+# ---------------------------------------------------------------------------
+# Watchdog stall dump carries the flight tail
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_dump_renders_flight_tail():
+    rec = FlightRecorder(capacity=4)
+    rec.note_step(gstep=7, loss=0.5)
+    rec.note_step(gstep=8, loss=0.4)
+    out = io.StringIO()
+    wd = StepWatchdog(
+        0.05,
+        get_context=lambda: {"last": {"kind": "step", "gstep": 8},
+                             "flight_tail": rec.tail(5)},
+        out=out,
+    )
+    with wd:
+        wd.arm("step 9")
+        time.sleep(0.4)
+        wd.disarm()
+    text = out.getvalue()
+    assert "flight tail (2 ring entries, oldest first)" in text
+    assert '"gstep": 7' in text and '"gstep": 8' in text
+
+
+# ---------------------------------------------------------------------------
+# Supervisor: flight as the fourth evidence source
+# ---------------------------------------------------------------------------
+
+
+def _flight_doc(**over):
+    doc = {
+        "schema": 1, "reason": "watchdog_escalation", "phase": "step",
+        "gstep": 3, "steps_seen": 3, "ring": [], "last_events": {},
+        "dumps": 1,
+    }
+    doc.update(over)
+    return doc
+
+
+def test_classify_hang_gains_site_from_flight_phase():
+    for phase, site in (("fetch", "data_stall"), ("step", "collective"),
+                        ("compile", "collective"),
+                        ("save", "checkpoint_gather")):
+        cls = classify_failure(HANG_EXIT_CODE,
+                               flight=_flight_doc(phase=phase))
+        assert cls.failure_class == "hang"
+        assert cls.evidence["hang_site"] == site
+        assert cls.evidence["flight"]["phase"] == phase
+    # no flight -> still a hang, just without the site refinement
+    bare = classify_failure(HANG_EXIT_CODE)
+    assert bare.failure_class == "hang" and "hang_site" not in bare.evidence
+
+
+def test_classify_oom_localizes_to_growing_device():
+    ring = [
+        {"kind": "step", "gstep": 0, "memory_peak_bytes": 100,
+         "per_device_peak_bytes": [100, 100]},
+        {"kind": "step", "gstep": 1, "memory_peak_bytes": 900,
+         "per_device_peak_bytes": [110, 900]},
+    ]
+    flight = _flight_doc(ring=ring, phase="step")
+    growth = watermark_growth(flight)
+    assert growth is not None
+    total, device = growth
+    assert device == 1  # device 1 grew 800, device 0 only 10
+    cls = classify_failure(
+        1, stderr_tail="RESOURCE_EXHAUSTED: out of memory", flight=flight)
+    assert cls.failure_class == "oom_step"  # steps_seen > 0, no records
+    assert cls.evidence["oom_device"] == 1
+    assert cls.evidence["oom_watermark_growth_bytes"] == total
+
+
+def test_classify_oom_steps_seen_tiebreak():
+    # No RunLog records came back, but the flight recorder saw steps:
+    # the OOM happened in steady state, not at compile.
+    stepped = classify_failure(
+        1, stderr_tail="RESOURCE_EXHAUSTED", records=(),
+        flight=_flight_doc(steps_seen=5))
+    assert stepped.failure_class == "oom_step"
+    fresh = classify_failure(
+        1, stderr_tail="RESOURCE_EXHAUSTED", records=(),
+        flight=_flight_doc(steps_seen=0, phase="compile"))
+    assert fresh.failure_class == "oom_compile"
+
+
+def test_flight_summary_round_trip():
+    ring = [
+        {"kind": "step", "gstep": 0, "memory_peak_bytes": 20,
+         "per_device_peak_bytes": [10, 20]},
+        {"kind": "step", "gstep": 1, "memory_peak_bytes": 80,
+         "per_device_peak_bytes": [15, 80]},
+    ]
+    s = flight_summary(_flight_doc(ring=ring))
+    assert s is not None
+    assert s["reason"] == "watchdog_escalation" and s["steps_seen"] == 3
+    assert s["watermark_growth_bytes"] == 60
+    assert s["watermark_growth_device"] == 1
+    assert flight_summary(None) is None
+
+
+def test_supervisor_incident_carries_flight_evidence(tmp_path):
+    """A fake leg that hands back a flight dump: the incident's evidence
+    must carry the summary AND the refined hang site."""
+
+    def launch(flags, env, attempt):
+        if attempt == 1:
+            return LegOutcome(
+                rc=HANG_EXIT_CODE,
+                flight=_flight_doc(phase="fetch", reason="watchdog_escalation"),
+            )
+        return LegOutcome(rc=0, result={"loss": 1.0, "final_step": 4})
+
+    flags = {"split-size": 2, "parts": 4, "batch-size": 4,
+             "num-spatial-parts": "4", "slice-method": "square"}
+    res = Supervisor(
+        "sp", "resnet", flags, workdir=str(tmp_path / "legs"),
+        launch=launch, _sleep=lambda s: None,
+    ).run()
+    assert res.ok
+    inc = res.incidents[0]
+    assert inc["failure_class"] == "hang"
+    ev = inc["evidence"]
+    assert ev["hang_site"] == "data_stall"
+    assert ev["flight"]["reason"] == "watchdog_escalation"
+
+
+def test_subprocess_launcher_reads_flight_dump(tmp_path, monkeypatch):
+    """The subprocess launcher picks up flight.json from the leg's attempt
+    dir — written here by a faked subprocess to keep the test
+    compile-free."""
+    import subprocess as _subprocess
+
+    from mpi4dl_tpu.resilience.supervisor import subprocess_leg_launcher
+
+    class _Proc:
+        returncode = HANG_EXIT_CODE
+
+    def fake_run(cmd, env=None, **kw):
+        # the leg "dumped" a flight record into its attempt dir before dying
+        adir = os.path.dirname(env["MPI4DL_CRASH_MARKER"])
+        with open(os.path.join(adir, FLIGHT_BASENAME), "w") as fh:
+            json.dump(_flight_doc(phase="save"), fh)
+        return _Proc()
+
+    monkeypatch.setattr(_subprocess, "run", fake_run)
+    launch = subprocess_leg_launcher("sp", "resnet", str(tmp_path))
+    out = launch({}, {}, 1)
+    assert out.flight is not None and out.flight["phase"] == "save"
+    cls = classify_failure(out.rc, marker=None, records=out.records or (),
+                           stderr_tail=out.stderr_tail or "",
+                           flight=out.flight)
+    assert cls.failure_class == "hang"
+    assert cls.evidence["hang_site"] == "checkpoint_gather"

@@ -66,11 +66,15 @@ def test_runlog_roundtrip(tmp_path):
     assert step["host_rss_peak_bytes"] is None or step["host_rss_peak_bytes"] > 0
 
 
-def test_runlog_truncated_line_skipped(tmp_path):
+def test_runlog_truncated_line_skipped(tmp_path, capsys):
     p = tmp_path / "r.jsonl"
     p.write_text('{"kind": "meta", "schema": 1, "t": 0}\n{"kind": "st')
     recs = obs.read_runlog(str(p))
     assert len(recs) == 1 and recs[0]["kind"] == "meta"
+    # the skip is audible: a crashed leg tears its last line mid-write and
+    # the evidence reader must say so, not silently drop the record
+    err = capsys.readouterr().err
+    assert "[obs]" in err and "torn record" in err and ":2:" in err
 
 
 def test_active_hatches_reflects_env(monkeypatch):
@@ -328,6 +332,35 @@ def test_report_golden(tmp_path):
         "total",
     ):
         assert needle in out, f"missing {needle!r} in:\n{out}"
+
+
+def test_report_hbm_skew_line(tmp_path):
+    """Step records carrying ``hbm_skew`` render the hot-vs-cold spread
+    line — the SP-imbalance signal the device-0-only watermark hid."""
+    import json as _json
+
+    from mpi4dl_tpu.obs.report import render_run
+
+    p = tmp_path / "skew.jsonl"
+    with open(p, "w") as fh:
+        fh.write(_json.dumps({"kind": "meta", "schema": 1, "t": 0.0,
+                              "config": {}}) + "\n")
+        for i, skew in enumerate([64, 3 * 1024 ** 2, 1024]):
+            fh.write(_json.dumps({
+                "kind": "step", "schema": 1, "t": 1.0 + i, "epoch": 0,
+                "step": i, "ms": 10.0, "images_per_sec": 800.0,
+                "loss": 1.0, "measured": True,
+                "memory_peak_bytes": 8 * 1024 ** 2, "hbm_skew": skew,
+            }) + "\n")
+    out = render_run(str(p))
+    assert "hbm skew: 3.0 MiB max spread across local devices" in out
+    # no skew fields -> no skew line (absent metric, not a lying zero)
+    q = tmp_path / "noskew.jsonl"
+    with open(q, "w") as fh:
+        fh.write(_json.dumps({"kind": "step", "schema": 1, "t": 1.0,
+                              "ms": 10.0, "images_per_sec": 800.0,
+                              "loss": 1.0, "measured": True}) + "\n")
+    assert "hbm skew" not in render_run(str(q))
 
 
 def test_report_pipeline_line(tmp_path):

@@ -1,0 +1,434 @@
+"""Trace export, metrics exposition, and trend gate (ISSUE 17).
+
+Covers: :func:`hlo_trace_events` on the synthetic scheduled modules from
+test_overlap (span windows, stall lanes, flow arrows, pipeline tick
+lanes) and on the real compiled lp engine (the >=90% wire-coverage
+acceptance gate); :func:`trace_from_runlog` on measured records; the
+OpenMetrics exposition parsed back field by field (plus the HTTP
+endpoint); and the ``obs report --trend`` regression gate's exit codes
+with the BENCH crash-tail recovery.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import urllib.request
+
+import jax
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from mpi4dl_tpu.obs import overlap
+from mpi4dl_tpu.obs.__main__ import main as obs_main
+from mpi4dl_tpu.obs.metrics import (
+    CONTENT_TYPE,
+    metrics_from_records,
+    serve_metrics,
+    write_metrics_file,
+)
+from mpi4dl_tpu.obs.trace import (
+    chrome_trace,
+    hlo_trace_events,
+    trace_from_runlog,
+)
+from mpi4dl_tpu.obs.trend import (
+    format_trend,
+    read_bench_artifact,
+    runlog_series,
+    trend_report,
+)
+from mpi4dl_tpu.utils.misc import _percentile
+from test_overlap import _EXPOSED, _HIDDEN, _ICI, _PEAK, _SYNC
+
+
+def _spans(events, pid=None, tid=None, cat=None):
+    return [e for e in events if e["ph"] == "X"
+            and (pid is None or e["pid"] == pid)
+            and (tid is None or e["tid"] == tid)
+            and (cat is None or e["cat"] == cat)]
+
+
+# ---------------------------------------------------------------------------
+# hlo_trace_events on the synthetic scheduled modules
+# ---------------------------------------------------------------------------
+
+
+def test_trace_hidden_window_has_wire_span_and_flow_no_stall():
+    ev = hlo_trace_events(_HIDDEN, peak=_PEAK, ici_bw=_ICI)
+    wire = _spans(ev, pid=1, tid=0, cat="wire")
+    assert len(wire) == 1
+    w = wire[0]
+    assert w["name"] == "collective-permute halo_exchange_spw"
+    assert w["dur"] == pytest.approx(100.0)  # 0.1 ms in us
+    assert w["args"]["exposed_ms"] == pytest.approx(0.0)
+    assert w["args"]["sync"] is False
+    assert _spans(ev, pid=1, tid=1) == []  # fully hidden: no stall span
+    # the async pair still draws its flow arrow start->done
+    flows = [e for e in ev if e["ph"] in ("s", "f")]
+    assert [e["ph"] for e in flows] == ["s", "f"]
+    assert flows[0]["id"] == flows[1]["id"]
+    assert flows[0]["name"] == flows[1]["name"] == w["name"]
+    assert flows[1]["bp"] == "e"
+
+
+def test_trace_exposed_window_draws_stall_lane():
+    ev = hlo_trace_events(_EXPOSED, peak=_PEAK, ici_bw=_ICI)
+    stalls = _spans(ev, pid=1, tid=1, cat="stall")
+    assert len(stalls) == 1
+    assert stalls[0]["dur"] == pytest.approx(100.0)  # fully exposed
+    assert stalls[0]["name"].startswith("stall collective-permute")
+
+
+def test_trace_sync_collective_has_no_flow_arrows():
+    ev = hlo_trace_events(_SYNC, peak=_PEAK, ici_bw=_ICI)
+    assert [e for e in ev if e["ph"] in ("s", "f")] == []
+    wire = _spans(ev, pid=1, tid=0, cat="wire")
+    assert len(wire) == 1 and wire[0]["args"]["sync"] is True
+    # sync wire is fully exposed: the stall lane mirrors it
+    assert len(_spans(ev, pid=1, tid=1, cat="stall")) == 1
+
+
+def test_trace_analytical_lanes_serialize_scope_costs():
+    ev = hlo_trace_events(_HIDDEN, peak=_PEAK, ici_bw=_ICI)
+    comp = _spans(ev, pid=2, tid=0, cat="compute")
+    assert any(s["name"] == "cell00" for s in comp)
+    wire = _spans(ev, pid=2, tid=1, cat="wire")
+    assert any(s["name"] == "halo_exchange_spw" for s in wire)
+    # serialized: spans laid end to end, no overlaps
+    comp.sort(key=lambda s: s["ts"])
+    for a, b in zip(comp, comp[1:]):
+        assert b["ts"] >= a["ts"] + a["dur"] - 1e-6
+
+
+@pytest.mark.parametrize("schedule,tickname", [("gpipe", "mb0"),
+                                               ("1f1b", "tick 1")])
+def test_trace_pipeline_tick_lanes(schedule, tickname):
+    ev = hlo_trace_events(_HIDDEN, peak=_PEAK, ici_bw=_ICI,
+                          schedule=schedule, stages=2, parts=2)
+    pipe = _spans(ev, pid=3)
+    assert pipe, "pipeline lanes missing"
+    lanes = {s["tid"] for s in pipe}
+    assert lanes == {0, 1}  # one lane per stage
+    names = {s["name"] for s in pipe}
+    assert tickname in names
+    assert "bubble (drain)" in names and "bubble (fill)" in names
+    # stage 0 fills first (no fill bubble), stage 1 drains last (no drain)
+    s0 = {s["name"] for s in pipe if s["tid"] == 0}
+    s1 = {s["name"] for s in pipe if s["tid"] == 1}
+    assert "bubble (fill)" not in s0 and "bubble (fill)" in s1
+    assert "bubble (drain)" in s0 and "bubble (drain)" not in s1
+    busy = [s for s in pipe if s["cat"] == "tick"]
+    assert all(s["args"]["schedule"] == schedule for s in busy)
+
+
+def test_chrome_trace_container_is_valid_json():
+    ev = hlo_trace_events(_HIDDEN, peak=_PEAK, ici_bw=_ICI)
+    doc = json.loads(json.dumps(chrome_trace(ev)))
+    assert doc["displayTimeUnit"] == "ms"
+    assert isinstance(doc["traceEvents"], list) and doc["traceEvents"]
+    for e in doc["traceEvents"]:
+        assert e["ph"] in ("X", "i", "M", "s", "f")
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        if e["ph"] == "X":
+            assert e["ts"] >= 0 and e["dur"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# Real lp engine: the coverage acceptance gate
+# ---------------------------------------------------------------------------
+
+
+def test_trace_lp_engine_covers_ledger_wire(devices8):
+    from mpi4dl_tpu.analysis.contracts.engines import _PARTS, _STAGES, \
+        build_engine
+
+    step, args = build_engine("lp")
+    cache_dir = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    try:
+        compiled = step.lower(*args).compile()
+    finally:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    text = compiled.as_text()
+    dev = jax.devices()[0]
+    led = overlap.overlap_ledger(text, device=dev)
+    ev = hlo_trace_events(text, label="lp", device=dev, schedule="gpipe",
+                          stages=_STAGES, parts=_PARTS)
+    wire = _spans(ev, pid=1, tid=0, cat="wire")
+    covered_ms = sum(s["dur"] for s in wire) / 1e3
+    total_ms = led["totals"]["wire_ms"]
+    assert total_ms > 0
+    assert covered_ms >= 0.9 * total_ms, (covered_ms, total_ms)
+    # every ledger scope row appears among the span names
+    span_text = " ".join(s["name"] for s in wire)
+    for row in led["rows"]:
+        assert row["scope"] in span_text, row["scope"]
+    # the pipeline tick lanes rode along
+    assert {s["tid"] for s in _spans(ev, pid=3)} == set(range(_STAGES))
+
+
+# ---------------------------------------------------------------------------
+# trace_from_runlog: measured lanes
+# ---------------------------------------------------------------------------
+
+
+def _measured_records():
+    t0 = 1000.0
+    return [
+        {"kind": "meta", "t": t0},
+        {"kind": "step", "t": t0 + 1.0, "epoch": 0, "step": 0, "ms": 80.0,
+         "loss": 2.0, "images_per_sec": 100.0, "measured": True,
+         "gstep": 0, "memory_peak_bytes": 512, "hbm_skew": 64},
+        {"kind": "checkpoint", "t": t0 + 2.0, "step_id": 1,
+         "gather_ms": 30.0, "write_ms": 20.0, "bytes": 4096},
+        {"kind": "anomaly", "t": t0 + 3.0, "gstep": 2,
+         "reason": "non-finite loss"},
+    ]
+
+
+def test_trace_from_runlog_lanes():
+    ev = trace_from_runlog(_measured_records(), label="toy")
+    steps = _spans(ev, tid=0, cat="step")
+    assert len(steps) == 1
+    s = steps[0]
+    assert s["name"] == "step e0:0" and s["dur"] == pytest.approx(80_000.0)
+    # the span ENDS at the record's write time (1 s after t0)
+    assert s["ts"] + s["dur"] == pytest.approx(1_000_000.0)
+    assert s["args"]["hbm_skew"] == 64
+    ck = _spans(ev, tid=1, cat="checkpoint")
+    assert len(ck) == 1 and ck[0]["dur"] == pytest.approx(50_000.0)
+    inst = [e for e in ev if e["ph"] == "i"]
+    assert len(inst) == 1
+    assert inst[0]["name"] == "anomaly non-finite loss"
+    assert inst[0]["args"]["gstep"] == 2
+    assert trace_from_runlog([]) == []
+
+
+def test_trace_cli_runlog(tmp_path):
+    rl = tmp_path / "r.jsonl"
+    with open(rl, "w") as fh:
+        for r in _measured_records():
+            fh.write(json.dumps(r) + "\n")
+    out = tmp_path / "trace.json"
+    assert obs_main(["trace", "--runlog", str(rl), "--out", str(out)]) == 0
+    doc = json.loads(out.read_text())
+    assert doc["traceEvents"]
+    # mutual exclusion: no source at all is a usage error
+    assert obs_main(["trace", "--out", str(out)]) == 2
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics exposition
+# ---------------------------------------------------------------------------
+
+
+def _metrics_records():
+    recs = [{"kind": "meta", "t": 0.0}]
+    for i, ms in enumerate([10.0, 20.0, 30.0, 40.0]):
+        recs.append({"kind": "step", "t": float(i), "ms": ms,
+                     "images_per_sec": 8000.0 / ms, "measured": True,
+                     "memory_peak_bytes": 1000 + i, "hbm_skew": 10 * i,
+                     "host_rss_peak_bytes": 5000})
+    recs.append({"kind": "step", "t": 9.0, "ms": 500.0, "measured": False})
+    recs.append({"kind": "overlap", "t": 10.0,
+                 "totals": {"bytes": 1_000_000, "quantized_bytes": 250_000}})
+    recs.append({"kind": "anomaly", "t": 11.0, "gstep": 2})
+    recs.append({"kind": "recovery", "t": 12.0})
+    recs.append({"kind": "supervisor", "t": 13.0, "failure_class": "hang"})
+    recs.append({"kind": "supervisor", "t": 14.0,
+                 "failure_class": "oom_step"})
+    recs.append({"kind": "supervisor_summary", "t": 15.0, "ok": True})
+    return recs
+
+
+def _parse_exposition(text):
+    """Field-by-field parse: families {name: type} + samples
+    {(name, labelstr): value}."""
+    families, samples = {}, {}
+    lines = text.splitlines()
+    assert lines[-1] == "# EOF"
+    for line in lines[:-1]:
+        if line.startswith("# TYPE "):
+            _, _, name, mtype = line.split(" ", 3)
+            families[name] = mtype
+        elif line.startswith("# HELP "):
+            continue
+        else:
+            metric, value = line.rsplit(" ", 1)
+            name, _, labels = metric.partition("{")
+            samples[(name, labels.rstrip("}"))] = float(value)
+    return families, samples
+
+
+def test_metrics_exposition_field_by_field():
+    text = metrics_from_records(_metrics_records())
+    families, samples = _parse_exposition(text)
+    assert families["mpi4dl_step_latency_ms"] == "summary"
+    assert families["mpi4dl_images_per_sec"] == "gauge"
+    assert families["mpi4dl_resilience_events"] == "counter"
+    assert families["mpi4dl_supervisor_incidents"] == "counter"
+
+    ms = [10.0, 20.0, 30.0, 40.0]  # the warmup 500 ms step is excluded
+    assert samples[("mpi4dl_step_latency_ms", 'quantile="0.5"')] == \
+        pytest.approx(_percentile(ms, 0.5))
+    assert samples[("mpi4dl_step_latency_ms", 'quantile="0.99"')] == \
+        pytest.approx(_percentile(ms, 0.99))
+    assert samples[("mpi4dl_step_latency_ms_sum", "")] == 100.0
+    assert samples[("mpi4dl_step_latency_ms_count", "")] == 4
+    assert samples[("mpi4dl_device_hbm_peak_bytes", "")] == 1003
+    assert samples[("mpi4dl_device_hbm_skew_bytes", "")] == 30
+    assert samples[("mpi4dl_host_rss_peak_bytes", "")] == 5000
+    assert samples[("mpi4dl_wire_bytes_per_step", 'kind="total"')] == 1e6
+    assert samples[("mpi4dl_wire_bytes_per_step", 'kind="quantized"')] == \
+        250_000
+    assert samples[("mpi4dl_wire_bytes_per_step", 'kind="raw"')] == 750_000
+    assert samples[("mpi4dl_resilience_events_total",
+                    'event="anomaly"')] == 1
+    assert samples[("mpi4dl_resilience_events_total",
+                    'event="recovery"')] == 1
+    assert samples[("mpi4dl_supervisor_incidents_total",
+                    'class="hang"')] == 1
+    assert samples[("mpi4dl_supervisor_incidents_total",
+                    'class="oom_step"')] == 1
+    assert samples[("mpi4dl_supervisor_ok", "")] == 1
+    assert samples[("mpi4dl_steps_total", "")] == 4
+
+
+def test_metrics_empty_records_is_bare_eof():
+    text = metrics_from_records([{"kind": "meta", "t": 0.0}])
+    assert text == "# EOF\n"
+
+
+def test_metrics_cli_and_file_sink(tmp_path, capsys):
+    rl = tmp_path / "m.jsonl"
+    with open(rl, "w") as fh:
+        for r in _metrics_records():
+            fh.write(json.dumps(r) + "\n")
+    out = tmp_path / "metrics.prom"
+    assert obs_main(["metrics", str(rl), "--out", str(out)]) == 0
+    assert out.read_text().endswith("# EOF\n")
+    # stdout mode prints the exposition itself
+    assert obs_main(["metrics", str(rl)]) == 0
+    assert "mpi4dl_step_latency_ms" in capsys.readouterr().out
+    assert obs_main(["metrics", str(tmp_path / "missing.jsonl")]) == 2
+    p = write_metrics_file(_metrics_records(), str(tmp_path / "w.prom"))
+    assert open(p).read().endswith("# EOF\n")
+
+
+def test_serve_metrics_scrape(tmp_path):
+    rl = tmp_path / "m.jsonl"
+    with open(rl, "w") as fh:
+        for r in _metrics_records():
+            fh.write(json.dumps(r) + "\n")
+    srv = serve_metrics(str(rl), 0)  # ephemeral port
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        port = srv.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics") as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"] == CONTENT_TYPE
+            body = resp.read().decode("utf-8")
+        assert "mpi4dl_step_latency_ms" in body and body.endswith("# EOF\n")
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/nope")
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        t.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# Trend gate
+# ---------------------------------------------------------------------------
+
+
+def _write_runlog(path, ms_values, t0):
+    with open(path, "w") as fh:
+        fh.write(json.dumps({"kind": "meta", "t": t0}) + "\n")
+        for i, ms in enumerate(ms_values):
+            fh.write(json.dumps({
+                "kind": "step", "t": t0 + i, "ms": ms,
+                "images_per_sec": 8000.0 / ms, "measured": True,
+            }) + "\n")
+
+
+def test_runlog_series_parsing():
+    assert runlog_series("/x/bench-resnet56-20260101-120000-p42.jsonl") == \
+        "bench-resnet56"
+    assert runlog_series("toy-20260101-120000-p7-1.jsonl") == "toy"
+    assert runlog_series("hand_named.jsonl") == "hand_named"
+
+
+def test_trend_gate_detects_regression(tmp_path, capsys):
+    d = tmp_path / "tele"
+    d.mkdir()
+    _write_runlog(d / "toy-20260101-000000-p1.jsonl", [10.0] * 4, 100.0)
+    _write_runlog(d / "toy-20260102-000000-p1.jsonl", [30.0] * 4, 200.0)
+    trend = trend_report(str(d))
+    assert trend["breaches"] >= 1
+    gate = trend["gates"][0]
+    assert gate["series"] == "toy"
+    regressed = {m["metric"] for m in gate["metrics"] if m["regressed"]}
+    assert "step ms (median)" in regressed
+    text = format_trend(trend)
+    assert "REGRESSION" in text and "series toy: 2 run(s)" in text
+
+    out = tmp_path / "trend.json"
+    rc = obs_main(["report", "--trend", str(d), "--trend-out", str(out)])
+    assert rc == 1
+    doc = json.loads(out.read_text())
+    assert doc["breaches"] == trend["breaches"]
+    assert "REGRESSION" in capsys.readouterr().out
+
+
+def test_trend_gate_clean_and_cross_series(tmp_path):
+    d = tmp_path / "tele"
+    d.mkdir()
+    # same series, no change -> clean gate
+    _write_runlog(d / "toy-20260101-000000-p1.jsonl", [10.0] * 4, 100.0)
+    _write_runlog(d / "toy-20260102-000000-p1.jsonl", [10.0] * 4, 200.0)
+    # a much slower run in a DIFFERENT series must not gate against toy
+    _write_runlog(d / "drill-20260103-000000-p1.jsonl", [900.0] * 4, 300.0)
+    trend = trend_report(str(d))
+    assert trend["breaches"] == 0
+    assert [g["series"] for g in trend["gates"]] == ["toy"]
+    assert obs_main(["report", "--trend", str(d)]) == 0
+    # a non-directory is a usage error, not a crash
+    assert obs_main(["report", "--trend", str(d / "nope")]) == 2
+
+
+def test_trend_bench_artifact_recovery(tmp_path):
+    good = {"rungs": {"2048": {"img_per_sec": 120.5, "mfu": 0.41,
+                               "timing_mode": "measured"}},
+            "source": "bench.py"}
+    (tmp_path / "BENCH_ci.json").write_text(json.dumps(good))
+    # a crash-captured ladder artifact: outer parsed is null, the result
+    # JSON lives front-truncated inside the tail
+    inner = json.dumps({"rungs": {"1024": {"img_per_sec": 50.0}}})
+    (tmp_path / "BENCH_r07.json").write_text(json.dumps({
+        "n": 7, "cmd": "python bench.py", "rc": 1,
+        "parsed": None, "tail": "…half a traceback… " + inner,
+    }))
+    (tmp_path / "BENCH_r08.json").write_text(json.dumps({
+        "n": 8, "rc": 1, "parsed": None, "tail": "no json here at all",
+    }))
+
+    ci = read_bench_artifact(str(tmp_path / "BENCH_ci.json"))
+    assert ci["rungs"]["2048"]["img_per_sec"] == 120.5
+    assert not ci["recovered"]
+    r07 = read_bench_artifact(str(tmp_path / "BENCH_r07.json"))
+    assert r07["recovered"] and r07["rungs"]["1024"]["img_per_sec"] == 50.0
+    r08 = read_bench_artifact(str(tmp_path / "BENCH_r08.json"))
+    assert not r08["rungs"] and "note" in r08
+
+    trend = trend_report(str(tmp_path))
+    assert trend["breaches"] == 0  # bench artifacts never gate
+    text = format_trend(trend)
+    assert "[recovered from crash tail]" in text
+    assert "skipped" in text
