@@ -311,8 +311,15 @@ def build_train(cfg: ParallelConfig, family: str, mesh):
 def _ensure_devices(need: int) -> None:
     """Self-provision an `need`-device CPU platform when the process is headed
     for CPU anyway and no backend is initialized yet (the conftest.py
-    fallback, applied just in time for script users)."""
-    if need <= 1:
+    fallback, applied just in time for script users).
+
+    A fleet leg is pinned to its slice: when the scheduler set
+    ``MPI4DL_FLEET_SLICE_DEVICES`` the process provisions EXACTLY that many
+    devices — the slice IS the job's world, and over-provisioning would let
+    a 4-device tenant silently compile onto its neighbor's devices."""
+    cap = os.environ.get("MPI4DL_FLEET_SLICE_DEVICES", "")
+    pinned = int(cap) if cap.isdigit() and int(cap) > 0 else None
+    if pinned is None and need <= 1:
         return
     import jax
 
@@ -327,7 +334,7 @@ def _ensure_devices(need: int) -> None:
     # by auto-fallback), so a live GPU/TPU is never hijacked.
     from mpi4dl_tpu.compat import ensure_host_device_count
 
-    ensure_host_device_count(max(need, 8))
+    ensure_host_device_count(pinned if pinned is not None else max(need, 8))
 
 
 def _open_telemetry(directory, family, cfg, spec, step, state, dataset,

@@ -155,6 +155,24 @@ HATCHES: Dict[str, Hatch] = {
               "Internal: where a supervised leg writes its structured "
               "crash marker (phase, step, error) on the way down — the "
               "supervisor points it at a per-attempt file.", internal=True),
+        Hatch("MPI4DL_FLEET_DEVICES", "8",
+              "Fleet scheduler: size of the shared device pool the "
+              "bin-packer carves into per-job slices "
+              "(docs/resilience.md, fleet scheduler)."),
+        Hatch("MPI4DL_FLEET_POISON_ATTEMPTS", "2",
+              "Fleet scheduler: failed supervisor RUNS (not leg attempts) "
+              "before a job is quarantined as poison instead of requeued — "
+              "the containment that keeps a doomed job from starving the "
+              "queue."),
+        Hatch("MPI4DL_FLEET_JOB", "<unset>",
+              "Internal: the owning fleet job id, stamped into every leg "
+              "subprocess so its result summary (and evidence artifacts) "
+              "are attributable — the cross-contamination check verifies "
+              "evidence stayed in its lane.", internal=True),
+        Hatch("MPI4DL_FLEET_SLICE_DEVICES", "<unset>",
+              "Internal: slice size the fleet scheduler pins a leg to; the "
+              "leg self-provisions EXACTLY this many virtual-mesh devices "
+              "instead of the 8-device default.", internal=True),
         Hatch("MPI4DL_NO_GUARD", "0",
               "1 = disable the anomaly guard (per-step finite-loss check "
               "with rollback to the last good checkpoint and poison-batch "

@@ -61,6 +61,7 @@ from mpi4dl_tpu.obs.trace import (
 from mpi4dl_tpu.obs.metrics import (
     metrics_from_records,
     metrics_from_runlog,
+    metrics_from_runlogs,
     serve_metrics,
     write_metrics_file,
 )
@@ -142,6 +143,7 @@ __all__ = [
     "jit_cache_size",
     "metrics_from_records",
     "metrics_from_runlog",
+    "metrics_from_runlogs",
     "mfu",
     "overlap_ledger",
     "peak_flops",

@@ -100,9 +100,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                           "(load in ui.perfetto.dev / chrome://tracing)")
     met = sub.add_parser(
         "metrics",
-        help="OpenMetrics/Prometheus text exposition of one RunLog",
+        help="OpenMetrics/Prometheus text exposition of one or more "
+             "RunLogs (many → one job-labeled exposition)",
     )
-    met.add_argument("path", help="run .jsonl file")
+    met.add_argument("paths", nargs="+", metavar="PATH",
+                     help="run .jsonl file(s) and/or directories (a "
+                          "directory expands to every *.jsonl under it, "
+                          "recursively — e.g. a fleet drill's workdir)")
     met.add_argument("--out", default=None, metavar="F",
                      help="write the exposition here (atomic) instead of "
                           "stdout")
@@ -326,35 +330,66 @@ def _trace_cmd(args) -> int:
     return 0
 
 
+def _metrics_paths(raw: List[str]) -> List[str]:
+    """Expand the metrics CLI's positional args: files pass through,
+    directories expand to every ``*.jsonl`` under them (recursive, sorted
+    — a fleet drill workdir becomes its fleet log + every job's
+    supervisor logs)."""
+    import glob
+
+    out: List[str] = []
+    for p in raw:
+        if os.path.isdir(p):
+            out.extend(sorted(
+                glob.glob(os.path.join(p, "**", "*.jsonl"), recursive=True)
+            ))
+        else:
+            out.append(p)
+    return out
+
+
 def _metrics_cmd(args) -> int:
-    """``obs metrics``: OpenMetrics exposition of one RunLog — stdout,
-    atomic file sink, and/or the stdlib HTTP endpoint."""
+    """``obs metrics``: OpenMetrics exposition of RunLog(s) — stdout,
+    atomic file sink, and/or the stdlib HTTP endpoint.  Multiple inputs
+    aggregate into ONE ``job``-labeled exposition (ISSUE 18)."""
     from mpi4dl_tpu.obs.metrics import (
         metrics_from_runlog,
+        metrics_from_runlogs,
         metrics_port_from_env,
         serve_metrics,
         write_metrics_file,
     )
     from mpi4dl_tpu.obs.runlog import read_runlog
 
-    try:
-        records = read_runlog(args.path)
-    except OSError as e:
-        print(f"obs metrics: cannot read {args.path}: {e}", file=sys.stderr)
+    paths = _metrics_paths(args.paths)
+    if not paths:
+        print(f"obs metrics: no .jsonl files in {args.paths}",
+              file=sys.stderr)
         return 2
-    if args.out:
-        write_metrics_file(records, args.out)
-        print(f"obs metrics: wrote {args.out}")
-    elif args.serve is None:
-        # stdout exposition (re-rendered so torn-line notes surface once)
-        sys.stdout.write(metrics_from_runlog(args.path))
+    single = paths[0] if len(paths) == 1 else None
+    try:
+        if single is not None and args.out:
+            write_metrics_file(read_runlog(single), args.out)
+            print(f"obs metrics: wrote {args.out}")
+        elif args.out:
+            from mpi4dl_tpu.obs.metrics import _atomic_write
+
+            _atomic_write(metrics_from_runlogs(paths), args.out)
+            print(f"obs metrics: wrote {args.out} "
+                  f"({len(paths)} runlogs, job-labeled)")
+        elif args.serve is None:
+            sys.stdout.write(metrics_from_runlog(single) if single
+                             else metrics_from_runlogs(paths))
+    except OSError as e:
+        print(f"obs metrics: cannot read input: {e}", file=sys.stderr)
+        return 2
     if args.serve is not None:
         port = args.serve if args.serve >= 0 else metrics_port_from_env()
         if port is None:
             print("obs metrics: --serve needs a PORT (or set "
                   "MPI4DL_METRICS_PORT)", file=sys.stderr)
             return 2
-        srv = serve_metrics(args.path, port)
+        srv = serve_metrics(single if single is not None else paths, port)
         host, bound = srv.server_address[0], srv.server_address[1]
         print(f"obs metrics: serving http://{host}:{bound}/metrics "
               "(Ctrl-C to stop)", file=sys.stderr)

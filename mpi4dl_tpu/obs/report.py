@@ -173,6 +173,33 @@ def render_run(path: str) -> str:
                 f"  {mark} {r.get('scenario')}: {r.get('verdict')}{extra}"
             )
 
+    # -- fleet timeline (ISSUE 18: the scheduler's decision ledger) --------
+    fleet = [r for r in records if r.get("kind") == "fleet"]
+    if fleet:
+        lines.append(f"fleet timeline: {len(fleet)} events")
+        for r in fleet:
+            bits = [f"  t={r.get('t'):>8} {r.get('event')}"]
+            if r.get("job"):
+                bits.append(str(r["job"]))
+            if r.get("state"):
+                bits.append(f"-> {r['state']}")
+            if r.get("slice"):
+                bits.append(str(r["slice"]))
+            if r.get("victim"):
+                bits.append(f"victim={r['victim']}")
+            if r.get("reason"):
+                bits.append(f"({r['reason']})")
+            lines.append("  ".join(bits))
+    fleet_sum = _first(records, "fleet_summary")
+    if fleet_sum is not None:
+        jobs = fleet_sum.get("jobs") or {}
+        lines.append(
+            f"fleet: {'OK' if fleet_sum.get('ok') else 'FAILED'} — "
+            + ", ".join(f"{j}={st}" for j, st in sorted(jobs.items()))
+            + (f"  (pool {fleet_sum.get('pool')}, "
+               f"{fleet_sum.get('events')} events)")
+        )
+
     # -- memory watermark --------------------------------------------------
     dev_peaks = [r.get("memory_peak_bytes") for r in steps
                  if r.get("memory_peak_bytes") is not None]

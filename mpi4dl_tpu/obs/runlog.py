@@ -4,7 +4,7 @@ One run = one ``.jsonl`` file; one line = one record, every record carrying
 ``kind`` (meta | cost | step | summary | hbm | timeline | overlap |
 mem_probe | junction_sweep | xprof_ops | readiness | anomaly | recovery |
 preempt | checkpoint | restore | quarantine | drill | drill_summary |
-supervisor | supervisor_summary | <custom> — field
+supervisor | supervisor_summary | fleet | fleet_summary | <custom> — field
 reference in docs/observability.md), ``t`` (unix
 seconds) and ``schema``.  The first record is the run's metadata — full config, mesh spec,
 device kind, jax version, active ``MPI4DL_*`` hatches — so a step file is

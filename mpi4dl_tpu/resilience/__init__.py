@@ -30,7 +30,13 @@ a multi-day pathology run.  This package turns the existing pieces
   (ISSUE 15): legs as subprocesses, typed failure taxonomy, per-class
   retry/backoff, poison-batch quarantine, degrade-and-continue.
 - :mod:`~mpi4dl_tpu.resilience.planner` — the degradation ladder + the
-  compile-only feasibility probe the supervisor re-plans with.
+  compile-only feasibility probe the supervisor re-plans with; ISSUE 18
+  adds the upward (re-expansion) search.
+- :mod:`~mpi4dl_tpu.resilience.allocator` /
+  :mod:`~mpi4dl_tpu.resilience.fleet` — the multi-tenant fleet scheduler
+  (ISSUE 18): bin-packed slices, typed job lifecycle, priority preemption,
+  displace/degrade/re-expand via elastic checkpoints, poison-job
+  quarantine, and the ``drill --fleet`` chaos matrix.
 
 Event schema, fault kinds, manifest format, recovery semantics:
 docs/resilience.md.
@@ -61,11 +67,27 @@ from mpi4dl_tpu.resilience.faults import (
     parse_fault,
     synthetic_oom,
 )
+from mpi4dl_tpu.resilience.allocator import PackResult, Request, Slice, pack
+from mpi4dl_tpu.resilience.fleet import (
+    JOB_STATES,
+    TERMINAL_STATES,
+    FleetJob,
+    FleetResult,
+    FleetScenario,
+    FleetScheduler,
+    fleet_knobs_from_env,
+    fleet_scenarios,
+    run_fleet_drills,
+    run_fleet_scenario,
+)
 from mpi4dl_tpu.resilience.planner import (
     Plan,
     compile_probe,
     degrade_candidates,
+    expand_candidates,
     plan_degrade,
+    plan_expand,
+    required_devices,
 )
 from mpi4dl_tpu.resilience.supervisor import (
     FAILURE_CLASSES,
@@ -94,7 +116,9 @@ __all__ = [
     "CKPT_FAULT_KINDS",
     "FAILURE_CLASSES",
     "FAULT_KINDS",
+    "JOB_STATES",
     "POLICIES",
+    "TERMINAL_STATES",
     "AnomalyError",
     "AnomalyGuard",
     "AsyncCheckpointWriter",
@@ -104,13 +128,20 @@ __all__ = [
     "FaultInjected",
     "FaultInjector",
     "FaultSpec",
+    "FleetJob",
+    "FleetResult",
+    "FleetScenario",
+    "FleetScheduler",
     "LegOutcome",
     "LoopResult",
     "MeshShrunk",
+    "PackResult",
     "Plan",
     "Policy",
     "PreemptionHandler",
+    "Request",
     "Scenario",
+    "Slice",
     "StepWatchdog",
     "Supervisor",
     "SupervisorResult",
@@ -122,13 +153,21 @@ __all__ = [
     "default_scenarios",
     "degrade_candidates",
     "dump_stacks",
+    "expand_candidates",
     "fault_from_env",
+    "fleet_knobs_from_env",
+    "fleet_scenarios",
     "global_norm",
     "lose_shard_files",
+    "pack",
     "parse_fault",
     "plan_degrade",
+    "plan_expand",
     "read_crash_marker",
+    "required_devices",
     "run_drills",
+    "run_fleet_drills",
+    "run_fleet_scenario",
     "run_scenario",
     "run_supervised",
     "run_supervisor_drills",

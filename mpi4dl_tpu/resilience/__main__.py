@@ -8,7 +8,10 @@ supervisor, and its leg entry point (docs/resilience.md).
     verdicts.  ``--supervisor`` runs the SUPERVISOR scenario matrix instead
     (clean / oom-degrade / oom-step-degrade / transient-io): fault into leg
     1 only, judge the classification, the feasibility-probed degrade, the
-    elastic resume, and the final loss against a control.
+    elastic resume, and the final loss against a control.  ``--fleet`` runs
+    the FLEET chaos matrix (ISSUE 18): N concurrent supervised jobs on
+    bin-packed slices with slice-kill, preempt-storm, crash-cascade,
+    poison-job, and re-expansion events, judged end to end.
 
 ``supervise``
     Run one training job under the elastic supervisor: legs as
@@ -90,9 +93,14 @@ def _cmd_drill(args, parser, full_argv) -> int:
         supervisor_scenarios,
         toy_runner,
     )
+    from mpi4dl_tpu.resilience.fleet import fleet_scenarios, run_fleet_drills
 
+    if args.fleet and args.supervisor:
+        parser.error("--fleet and --supervisor are mutually exclusive")
     os.makedirs(args.out, exist_ok=True)
-    if args.supervisor:
+    if args.fleet:
+        scenarios = fleet_scenarios()
+    elif args.supervisor:
         scenarios = supervisor_scenarios()
     else:
         scenarios = default_scenarios(reshape_spec=args.reshape)
@@ -108,9 +116,16 @@ def _cmd_drill(args, parser, full_argv) -> int:
     runlog.write_meta(family=args.family, model=args.model,
                       scenarios=[s.name for s in scenarios],
                       toy=args.toy, supervisor=args.supervisor,
-                      argv=list(full_argv))
+                      fleet=args.fleet, argv=list(full_argv))
     try:
-        if args.supervisor:
+        if args.fleet:
+            # Legs are subprocesses pinned to their slice via
+            # MPI4DL_FLEET_SLICE_DEVICES — this process never touches the
+            # backend, so no device provisioning here either.
+            verdicts = run_fleet_drills(
+                scenarios, args.out, runlog=runlog, log=print,
+            )
+        elif args.supervisor:
             # Legs are SUBPROCESSES here (fresh backend per attempt), so
             # neither the compile-cache hazard below nor device
             # provisioning applies to this process.
@@ -224,6 +239,10 @@ def main(argv=None) -> int:
                    help="run the SUPERVISOR scenario matrix (classification"
                         " + degrade-and-continue + backoff) instead of the "
                         "single-leg matrix")
+    d.add_argument("--fleet", action="store_true",
+                   help="run the FLEET chaos matrix (multi-tenant "
+                        "scheduler: slice-kill, preempt-storm, "
+                        "crash-cascade, poison-job, re-expansion)")
 
     s = sub.add_parser(
         "supervise",

@@ -1,0 +1,104 @@
+"""Slice allocator: bin-pack prioritized jobs onto a shared device pool
+(ISSUE 18, fleet tentpole).
+
+The fleet scheduler treats the host's virtual mesh as one flat pool of
+device indices and carves it into per-job **slices** — contiguous-by-id
+subsets a job's leg subprocesses are pinned to (``MPI4DL_FLEET_SLICE_DEVICES``
+caps the leg's self-provisioned CPU device count at the slice size, so a
+4-device job really runs on a 4-device mesh).  Packing is deterministic
+first-fit-decreasing:
+
+- requests sort by (priority desc, demand desc, id) — high-priority jobs
+  pick first, and among equals the bigger job goes first so fragmentation
+  hits the small jobs that can still fit in the gaps;
+- a request takes the lowest-numbered free devices (stable slice ids make
+  the fleet RunLog readable and the drills reproducible);
+- ``keep`` preserves existing placements whose devices all survived a pool
+  shrink — a job whose slice lost devices is *displaced* and must re-pack
+  (usually onto a planner-degraded geometry).
+
+Pure data + functions, no threads: the scheduler serializes all calls.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Slice:
+    """One job's share of the pool: a fixed tuple of device indices."""
+
+    devices: Tuple[int, ...]
+
+    def __len__(self) -> int:
+        return len(self.devices)
+
+    def describe(self) -> str:
+        d = self.devices
+        if d and d == tuple(range(d[0], d[0] + len(d))):
+            return f"[{d[0]}-{d[-1]}]" if len(d) > 1 else f"[{d[0]}]"
+        return "[" + ",".join(str(i) for i in d) + "]"
+
+
+@dataclasses.dataclass(frozen=True)
+class Request:
+    """One job's demand on the pool."""
+
+    id: str
+    devices: int
+    priority: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class PackResult:
+    """One deterministic packing: who got which slice, who did not fit,
+    and what remains free."""
+
+    placed: Dict[str, Slice]
+    unplaced: List[str]
+    free: Tuple[int, ...]
+
+
+def pack(requests: Sequence[Request], pool: Sequence[int],
+         keep: Optional[Mapping[str, Slice]] = None) -> PackResult:
+    """First-fit-decreasing bin-pack of ``requests`` onto ``pool``.
+
+    ``keep`` placements are honored verbatim when every kept device is
+    still in the pool AND the kept job is among the requests; a kept slice
+    with vanished devices is dropped (the job re-packs like a new arrival
+    — the fleet marks it displaced).  Raises ``ValueError`` on duplicate
+    request ids or non-positive demands: a malformed fleet spec is a bug,
+    not a scheduling outcome."""
+    pool_set = set(int(d) for d in pool)
+    ids = [r.id for r in requests]
+    if len(set(ids)) != len(ids):
+        raise ValueError(f"duplicate request ids in {sorted(ids)}")
+    for r in requests:
+        if r.devices <= 0:
+            raise ValueError(f"request {r.id!r}: demand must be positive, "
+                             f"got {r.devices}")
+
+    placed: Dict[str, Slice] = {}
+    taken: set = set()
+    for rid, sl in (keep or {}).items():
+        if rid in set(ids) and all(d in pool_set for d in sl.devices):
+            placed[rid] = sl
+            taken |= set(sl.devices)
+
+    order = sorted(
+        (r for r in requests if r.id not in placed),
+        key=lambda r: (-r.priority, -r.devices, r.id),
+    )
+    unplaced: List[str] = []
+    for r in order:
+        avail = sorted(pool_set - taken)
+        if len(avail) < r.devices:
+            unplaced.append(r.id)
+            continue
+        sl = Slice(tuple(avail[: r.devices]))
+        placed[r.id] = sl
+        taken |= set(sl.devices)
+    return PackResult(placed=placed, unplaced=unplaced,
+                      free=tuple(sorted(pool_set - taken)))

@@ -31,6 +31,13 @@ take the supervisor with it) and reads the compiled
 ``memory_analysis`` peak; a candidate is feasible when the probe compiles
 and — when a byte budget is known — fits it.  The chosen plan, its rungs,
 and the probe evidence ride the ``supervisor`` incident record.
+
+ISSUE 18 adds the UPWARD search: :func:`expand_candidates` /
+:func:`plan_expand` walk the same ladder in reverse — when the fleet
+scheduler frees devices, a degraded job re-expands toward its preferred
+geometry (largest feasible candidate first, same device-budget and
+compile-probe gates, skip reasons recorded) from the same elastic
+checkpoint it degraded with.
 """
 
 from __future__ import annotations
@@ -195,6 +202,135 @@ def plan_degrade(
     )
     skipped: List[Dict[str, Any]] = []
     for cand in degrade_candidates(flags, family):
+        if devices is not None:
+            need = required_devices(cand.flags, family)
+            if need > devices:
+                skipped.append({"rungs": cand.rungs, "reason":
+                                f"needs {need} devices, have {devices}"})
+                continue
+        pe: Dict[str, Any] = {"skipped": skipped} if skipped else {}
+        if probe is not None:
+            peak = probe(cand.flags, cand.env)
+            if peak == INFEASIBLE:
+                skipped.append({"rungs": cand.rungs,
+                                "reason": "probe failed to compile"})
+                continue
+            if peak is None:
+                pe["probe"] = "unavailable — accepted unprobed"
+            else:
+                pe["probe_peak_gb"] = peak
+                pe["budget_gb"] = budget_gb
+                if budget_gb is not None and peak > budget_gb:
+                    skipped.append({
+                        "rungs": cand.rungs,
+                        "reason": f"probe peak {peak} GB > budget "
+                                  f"{budget_gb} GB",
+                    })
+                    continue
+        else:
+            pe["probe"] = "skipped (no probe configured)"
+        return dataclasses.replace(cand, probe_evidence=pe)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Upward search: re-expansion toward the preferred geometry (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+
+def expand_candidates(flags: Mapping[str, Any],
+                      preferred: Mapping[str, Any],
+                      family: str) -> List[Plan]:
+    """The ladder walked UPWARD: cumulative candidates that undo the
+    degrade levers still separating ``flags`` from ``preferred``, in the
+    degrade ladder's own order (junction, parts, stripe, SP geometry) so
+    candidate *k* restores levers 1..k and the LAST candidate is the
+    preferred geometry itself.  Only the four ladder-controlled keys are
+    touched — anything else in ``flags`` (checkpoint dir, steps, ...)
+    rides along unchanged, which is what lets a degraded job re-expand
+    from the same elastic checkpoint.  Empty when the config already sits
+    at its preferred geometry."""
+    cands: List[Plan] = []
+    cur = dict(flags)
+    env: Dict[str, str] = {}
+    delta: Dict[str, Any] = {}
+    rungs: List[str] = []
+
+    def restore(key: str) -> None:
+        if key in preferred:
+            cur[key] = preferred[key]
+        else:
+            cur.pop(key, None)
+
+    def push(note: str) -> None:
+        cands.append(Plan(
+            flags=dict(cur), env=dict(env), delta=dict(delta),
+            rungs=list(rungs), note=note, probe_evidence={},
+        ))
+
+    # Rung 1: restore a pinned junction the degrade moved to "auto".
+    su_now = str(_flag(flags, "spatial-until", "") or "")
+    su_pref = str(_flag(preferred, "spatial-until", "") or "")
+    if su_now != su_pref:
+        restore("spatial-until")
+        delta["spatial-until"] = {"from": su_now or None,
+                                  "to": su_pref or None}
+        rungs.append("restore_junction")
+        push("junction restored to the preferred placement")
+
+    # Rung 2: grow parts back (micro-batch trail restored).
+    parts_now = int(_flag(flags, "parts", 1))
+    parts_pref = int(_flag(preferred, "parts", 1))
+    if parts_pref > parts_now:
+        restore("parts")
+        delta["parts"] = {"from": parts_now, "to": parts_pref}
+        rungs.append("restore_parts")
+        push(f"parts {parts_now} -> {parts_pref}")
+
+    # Rung 3: drop the stripe-wise backward the degrade enabled.
+    stripe_now = bool(_flag(flags, "stripe-bwd", False))
+    stripe_pref = bool(_flag(preferred, "stripe-bwd", False))
+    if stripe_now and not stripe_pref:
+        restore("stripe-bwd")
+        # Explicit "0" so an inherited MPI4DL_STRIPE_BWD=1 from the
+        # degraded leg's environment cannot silently re-enable it.
+        env["MPI4DL_STRIPE_BWD"] = "0"
+        delta["stripe-bwd"] = {"from": True, "to": False}
+        rungs.append("unstripe_bwd")
+        push("stripe-wise SP-region backward disabled")
+
+    # Rung 4: grow the SP geometry — the only rung that ASKS for devices,
+    # so it comes last: a partial expansion that stops short of it still
+    # fits the current slice.
+    sp_now = _first_sp_parts(flags)
+    sp_pref = _first_sp_parts(preferred)
+    if family in _SPATIAL_FAMILIES and sp_pref > sp_now:
+        restore("num-spatial-parts")
+        delta["num-spatial-parts"] = {"from": sp_now, "to": sp_pref}
+        rungs.append("grow_sp")
+        push(f"spatial tiles {sp_now} -> {sp_pref}")
+    return cands
+
+
+def plan_expand(
+    flags: Mapping[str, Any],
+    preferred: Mapping[str, Any],
+    family: str,
+    *,
+    devices: Optional[int] = None,
+    budget_gb: Optional[float] = None,
+    probe: Optional[Callable[[Mapping[str, Any], Mapping[str, str]],
+                             Optional[float]]] = None,
+) -> Optional[Plan]:
+    """The LARGEST feasible expansion of a degraded config toward its
+    preferred geometry, or ``None`` when no upward move fits (stay
+    degraded).  Mirror image of :func:`plan_degrade`: candidates are
+    walked most-expanded-first, gated by the free-device budget and the
+    compile-only probe; every rejection rides the returned plan's
+    ``probe_evidence["skipped"]`` so the fleet's ``expand`` incident can
+    SAY why the job landed where it did."""
+    skipped: List[Dict[str, Any]] = []
+    for cand in reversed(expand_candidates(flags, preferred, family)):
         if devices is not None:
             need = required_devices(cand.flags, family)
             if need > devices:

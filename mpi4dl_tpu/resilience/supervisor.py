@@ -343,16 +343,19 @@ POLICIES: Dict[str, Policy] = {
 
 
 def backoff_delay(attempt: int, *, base: float = 1.0, cap: float = 30.0,
-                  jitter: float = 0.25, seed: int = 0) -> float:
+                  jitter: float = 0.25, seed: int = 0,
+                  job: str = "") -> float:
     """Exponential backoff with bounded jitter, deterministic under
-    ``seed``: ``min(cap, base * 2**(attempt-1))`` scaled by a factor in
-    ``[1-jitter, 1+jitter]`` drawn from ``Random((seed, attempt))`` — two
-    supervisors with different seeds de-synchronize their retries (the
-    thundering-herd point of jitter) while one supervisor's schedule is
-    reproducible."""
+    ``(job, seed)``: ``min(cap, base * 2**(attempt-1))`` scaled by a factor
+    in ``[1-jitter, 1+jitter]`` drawn from ``Random((job, seed, attempt))``
+    — two supervisors with different seeds OR different fleet job ids
+    de-synchronize their retries (the thundering-herd point of jitter: a
+    fleet's jobs share one seed but must not hammer shared I/O in
+    lockstep) while one supervisor's schedule stays reproducible."""
     raw = min(float(cap), float(base) * (2.0 ** max(0, attempt - 1)))
     # str seeds hash via sha512 — stable across processes, unlike tuples.
-    rng = random.Random(f"{seed}:{attempt}")
+    rng = random.Random(f"{job}:{seed}:{attempt}" if job
+                        else f"{seed}:{attempt}")
     return raw * (1.0 + jitter * (2.0 * rng.random() - 1.0))
 
 
@@ -437,18 +440,28 @@ def _leg_runlog_records(tele_dir: str) -> List[Dict[str, Any]]:
 
 def subprocess_leg_launcher(
     family: str, model: str, workdir: str,
-    *, timeout: Optional[float] = None,
+    *, timeout: Optional[float] = None, job: str = "",
+    on_spawn: Optional[Callable[[Any], None]] = None,
 ) -> Callable[[Mapping[str, Any], Mapping[str, str], int], LegOutcome]:
     """The real launcher: each attempt is one fresh
     ``python -m mpi4dl_tpu.resilience leg`` subprocess (fresh backend, so a
     compile-OOM retry is sound and the jax-0.4.x same-program compile-cache
     hazard documented in drill.py cannot occur across attempts).  Per-
     attempt artifacts land under ``workdir/attempt<N>/``: crash marker, leg
-    result JSON, telemetry dir, stderr."""
+    result JSON, telemetry dir, stderr.
+
+    ``job`` namespaces every per-attempt evidence artifact by fleet job id
+    (``workdir/<job>/attempt<N>/`` + the ``MPI4DL_FLEET_JOB`` env tag), so
+    N concurrent supervisors sharing one fleet workdir cannot clobber each
+    other's markers / flight dumps / leg RunLogs.  ``on_spawn(proc)`` is
+    called with the live ``Popen`` handle the moment the leg starts — the
+    fleet scheduler registers it there so a preemption drain can SIGTERM
+    the in-flight leg instead of waiting for it."""
 
     def launch(flags: Mapping[str, Any], env_extra: Mapping[str, str],
                attempt: int) -> LegOutcome:
-        adir = os.path.join(workdir, f"attempt{attempt}")
+        adir = (os.path.join(workdir, job, f"attempt{attempt}") if job
+                else os.path.join(workdir, f"attempt{attempt}"))
         os.makedirs(adir, exist_ok=True)
         marker = os.path.join(adir, "crash_marker.json")
         result_path = os.path.join(adir, "leg_result.json")
@@ -467,15 +480,20 @@ def subprocess_leg_launcher(
         env.pop("MPI4DL_FAULT", None)
         env.update(env_extra)
         env["MPI4DL_CRASH_MARKER"] = marker
+        if job:
+            env["MPI4DL_FLEET_JOB"] = job
         stderr_path = os.path.join(adir, "leg.stderr")
         with open(stderr_path, "wb") as errf:
             try:
-                proc = subprocess.run(
+                proc = subprocess.Popen(
                     cmd, env=env, stdout=errf, stderr=subprocess.STDOUT,
-                    timeout=timeout,
                 )
-                rc: Optional[int] = proc.returncode
+                if on_spawn is not None:
+                    on_spawn(proc)
+                rc: Optional[int] = proc.wait(timeout=timeout)
             except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
                 rc = None  # leg wedged past the hard timeout: treat as hang
         result = None
         try:
@@ -523,6 +541,12 @@ def run_leg(family: str, model: str, argv: Sequence[str],
         if marker and not os.path.exists(marker):
             write_crash_marker(marker, phase="build", error=e)
         raise
+    fleet_job = os.environ.get("MPI4DL_FLEET_JOB")
+    if fleet_job:
+        # Tag the summary with the owning fleet job: the scheduler's
+        # cross-contamination check verifies evidence stayed in its lane.
+        result = dict(result)
+        result["fleet_job"] = fleet_job
     if result_path:
         tmp = f"{result_path}.tmp.{os.getpid()}"
         with open(tmp, "w", encoding="utf-8") as f:
@@ -554,6 +578,10 @@ class SupervisorResult:
     flags: Optional[Dict[str, Any]] = None  # the flags the final leg ran
     env: Dict[str, str] = dataclasses.field(default_factory=dict)
     reason: str = ""  # non-empty on failure
+    # True when the fleet's stop hook drained this supervisor (graceful
+    # preemption / migration) — NOT a job failure: the checkpoint is
+    # durable and the scheduler relaunches elsewhere.
+    stopped: bool = False
 
 
 class Supervisor:
@@ -579,6 +607,9 @@ class Supervisor:
                  backoff_cap: Optional[float] = None,
                  seed: int = 0,
                  fault: str = "",
+                 job: str = "",
+                 stop: Optional[Callable[[], str]] = None,
+                 on_spawn: Optional[Callable[[Any], None]] = None,
                  log: Callable[[str], None] = lambda s: None,
                  _sleep: Callable[[float], None] = time.sleep):
         knobs = supervise_knobs_from_env(max_attempts, backoff_base,
@@ -589,7 +620,8 @@ class Supervisor:
         self.runlog = runlog
         self.launch = (
             launch if launch is not None
-            else subprocess_leg_launcher(family, model, workdir)
+            else subprocess_leg_launcher(family, model, workdir, job=job,
+                                         on_spawn=on_spawn)
         )
         self.probe = probe
         self.budget_gb = budget_gb
@@ -598,6 +630,11 @@ class Supervisor:
         self.backoff_cap = float(knobs["cap"])
         self.seed = seed
         self.fault = fault
+        self.job = job
+        # ``stop() -> reason`` is polled between legs: a non-empty string
+        # ends the run with ``stopped=True`` instead of relaunching (the
+        # fleet scheduler's graceful preemption/migration drain).
+        self.stop = stop
         self.log = log
         self._sleep = _sleep
 
@@ -618,6 +655,7 @@ class Supervisor:
                 "supervisor_summary", ok=res.ok, attempts=res.attempts,
                 incidents=len(res.incidents), reason=res.reason,
                 final_flags=dict(res.flags or {}), final_env=dict(res.env),
+                stopped=res.stopped, job=self.job or None,
             )
         return res
 
@@ -629,13 +667,26 @@ class Supervisor:
         incidents: List[Dict[str, Any]] = []
         per_class: Dict[str, int] = {}
         quarantined: set = set()
+        last_final: Optional[Dict[str, Any]] = None
         attempt = 0
         while attempt < self.max_attempts:
+            why = self.stop() if self.stop is not None else ""
+            if why:
+                # Drained by the fleet: surface the last leg's summary (the
+                # preempted leg checkpointed on the way out) and say so —
+                # a stop is a scheduling decision, not a job failure.
+                return self._summary(SupervisorResult(
+                    ok=False, attempts=attempt, incidents=incidents,
+                    final=last_final, flags=flags, env=env_extra,
+                    reason=why, stopped=True,
+                ))
             attempt += 1
             env = dict(env_extra)
             if self.fault and attempt == 1:
                 env["MPI4DL_FAULT"] = self.fault
             out = self.launch(flags, env, attempt)
+            if out.result is not None:
+                last_final = out.result
             if out.rc == 0 and not (out.result or {}).get("preempted"):
                 return self._summary(SupervisorResult(
                     ok=True, attempts=attempt, incidents=incidents,
@@ -729,7 +780,7 @@ class Supervisor:
             if apply_backoff:
                 delay = backoff_delay(
                     nth, base=self.backoff_base, cap=self.backoff_cap,
-                    seed=self.seed,
+                    seed=self.seed, job=self.job,
                 )
                 incident["backoff_s"] = round(delay, 3)
                 incidents.append(incident)

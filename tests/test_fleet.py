@@ -1,0 +1,668 @@
+"""Multi-tenant fleet scheduler tests (ISSUE 18).
+
+Three layers, cheapest first:
+
+- allocator: deterministic FFD bin-packing of prioritized slice requests;
+- planner upward search: ``expand_candidates`` / ``plan_expand`` (the
+  re-expansion ladder, device/probe gates, expand-then-degrade round trip);
+- FleetScheduler: the full control plane driven by FAKE leg launchers (no
+  jax, no subprocesses) — degraded admission, priority preemption with a
+  graceful drain, poison-job quarantine, slice loss, re-expansion, typed
+  lifecycle legality, and the job-namespaced evidence contract.
+
+One ``@pytest.mark.slow`` case runs a real chaos scenario end to end with
+subprocess legs on the CPU virtual mesh (the CI ``fleet-drill`` lane).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import pytest
+
+from mpi4dl_tpu.resilience import (
+    FleetJob,
+    FleetResult,
+    FleetScenario,
+    FleetScheduler,
+    LegOutcome,
+    Request,
+    Slice,
+    expand_candidates,
+    fleet_knobs_from_env,
+    fleet_scenarios,
+    pack,
+    plan_degrade,
+    plan_expand,
+    required_devices,
+    run_fleet_scenario,
+)
+from mpi4dl_tpu.resilience.fleet import (
+    JOB_STATES,
+    TERMINAL_STATES,
+    _TRANSITIONS,
+    _contamination_problems,
+)
+from mpi4dl_tpu.resilience.planner import INFEASIBLE
+
+# A plain-SP job whose preferred geometry already pins the elastic levers:
+# the ladder between preferred and 2-device survival is {stripe_bwd,
+# shrink_sp} — the same shape the fleet drill matrix uses.
+_SP4 = {
+    "num-spatial-parts": "4", "slice-method": "horizontal",
+    "spatial-until": "auto", "batch-size": 4,
+}
+
+
+@pytest.fixture(autouse=True)
+def _clean_fleet_env(monkeypatch):
+    """The ladder and knob helpers read MPI4DL_* hatches — a leaked value
+    would silently change which rungs exist."""
+    for name in ("MPI4DL_STRIPE_BWD", "MPI4DL_FLEET_DEVICES",
+                 "MPI4DL_FLEET_POISON_ATTEMPTS", "MPI4DL_FLEET_JOB",
+                 "MPI4DL_FLEET_SLICE_DEVICES",
+                 "MPI4DL_SUPERVISE_MAX_ATTEMPTS"):
+        monkeypatch.delenv(name, raising=False)
+    # Failed fake legs back off for real (the fleet Supervisor uses
+    # time.sleep); keep those tests fast.
+    monkeypatch.setenv("MPI4DL_SUPERVISE_BACKOFF", "0.01")
+    monkeypatch.setenv("MPI4DL_SUPERVISE_BACKOFF_CAP", "0.05")
+
+
+# ---------------------------------------------------------------------------
+# Allocator: deterministic FFD bin-packing
+# ---------------------------------------------------------------------------
+
+
+def test_pack_priority_then_size_then_id_deterministic():
+    reqs = [Request("a", 2, priority=0), Request("b", 4, priority=5),
+            Request("c", 2, priority=0)]
+    first = pack(reqs, range(8))
+    again = pack(list(reversed(reqs)), range(8))
+    assert first == again  # input order never matters
+    assert first.placed["b"].devices == (0, 1, 2, 3)  # priority picks first
+    assert first.placed["a"].devices == (4, 5)  # equal prio+size: id order
+    assert first.placed["c"].devices == (6, 7)
+    assert first.unplaced == [] and first.free == ()
+
+
+def test_pack_takes_lowest_numbered_free_devices_and_reports_unplaced():
+    res = pack([Request("x", 2), Request("big", 4)], [9, 1, 5, 3])
+    assert res.placed["big"].devices == (1, 3, 5, 9)
+    assert res.unplaced == ["x"] and res.free == ()
+    assert Slice((0, 1, 2, 3)).describe() == "[0-3]"
+    assert Slice((1, 3)).describe() == "[1,3]"
+
+
+def test_pack_keep_honored_only_while_devices_survive():
+    keep = {"a": Slice((4, 5))}
+    res = pack([Request("a", 2), Request("b", 2)], range(8), keep=keep)
+    assert res.placed["a"] == keep["a"]  # kept verbatim
+    assert res.placed["b"].devices == (0, 1)
+    # Pool shrank under the kept slice: the job re-packs like a new arrival.
+    res2 = pack([Request("a", 2)], range(4), keep={"a": Slice((4, 5))})
+    assert res2.placed["a"].devices == (0, 1)
+    # keep for an id that is NOT requested does not squat on devices.
+    res3 = pack([Request("b", 8)], range(8), keep={"ghost": Slice((0, 1))})
+    assert res3.placed["b"].devices == tuple(range(8))
+
+
+def test_pack_rejects_malformed_specs():
+    with pytest.raises(ValueError, match="duplicate"):
+        pack([Request("a", 1), Request("a", 2)], range(4))
+    with pytest.raises(ValueError, match="positive"):
+        pack([Request("a", 0)], range(4))
+
+
+# ---------------------------------------------------------------------------
+# Planner upward search (satellite: re-expansion ladder)
+# ---------------------------------------------------------------------------
+
+_PREF = {"num-spatial-parts": "4", "slice-method": "horizontal",
+         "spatial-until": "3", "parts": 4, "batch-size": 4}
+_DEG = {"num-spatial-parts": "2", "slice-method": "horizontal",
+        "spatial-until": "auto", "parts": 2, "batch-size": 4,
+        "stripe-bwd": True}
+
+
+def test_expand_candidates_cumulative_rung_order():
+    cands = expand_candidates(_DEG, _PREF, "sp")
+    assert [c.rungs for c in cands] == [
+        ["restore_junction"],
+        ["restore_junction", "restore_parts"],
+        ["restore_junction", "restore_parts", "unstripe_bwd"],
+        ["restore_junction", "restore_parts", "unstripe_bwd", "grow_sp"],
+    ]
+    # The last candidate IS the preferred geometry, stripe pinned off via
+    # env so an inherited MPI4DL_STRIPE_BWD=1 cannot re-enable it.
+    assert cands[-1].flags == _PREF
+    assert cands[-1].env.get("MPI4DL_STRIPE_BWD") == "0"
+    # Device demand only grows at the final (grow_sp) rung.
+    assert required_devices(cands[-2].flags, "sp") == 2
+    assert required_devices(cands[-1].flags, "sp") == 4
+    # Already at the preferred geometry: nothing to restore.
+    assert expand_candidates(_PREF, _PREF, "sp") == []
+
+
+def test_plan_expand_respects_device_budget_and_records_skips():
+    plan = plan_expand(_DEG, _PREF, "sp", devices=2)
+    assert plan is not None
+    # Largest-first walk: the full expansion needs 4 devices, only 2 are
+    # free — it is SKIPPED with a reason, and the best device-neutral
+    # expansion wins.
+    assert plan.rungs == ["restore_junction", "restore_parts",
+                          "unstripe_bwd"]
+    skipped = plan.probe_evidence["skipped"]
+    assert any("grow_sp" in s["rungs"] and "devices" in s["reason"]
+               for s in skipped)
+    assert plan.probe_evidence["probe"] == "skipped (no probe configured)"
+    # With the devices for it, the preferred geometry is chosen outright.
+    full = plan_expand(_DEG, _PREF, "sp", devices=8)
+    assert full is not None and full.flags == _PREF
+
+
+def test_plan_expand_probe_gates_infeasible_and_over_budget():
+    def oom_probe(flags, env):
+        return INFEASIBLE if flags["num-spatial-parts"] == "4" else 0.5
+
+    plan = plan_expand(_DEG, _PREF, "sp", devices=8, probe=oom_probe)
+    assert plan is not None
+    assert "grow_sp" not in plan.rungs
+    assert any(s["reason"] == "probe failed to compile"
+               for s in plan.probe_evidence["skipped"])
+    assert plan.probe_evidence["probe_peak_gb"] == 0.5
+
+    def big_probe(flags, env):
+        return 10.0 if flags["num-spatial-parts"] == "4" else 0.5
+
+    plan = plan_expand(_DEG, _PREF, "sp", devices=8, probe=big_probe,
+                       budget_gb=1.0)
+    assert plan is not None and "grow_sp" not in plan.rungs
+    assert any("budget" in s["reason"]
+               for s in plan.probe_evidence["skipped"])
+    # Probe rejects everything: stay degraded.
+    assert plan_expand(_DEG, _PREF, "sp", devices=8,
+                       probe=lambda f, e: INFEASIBLE) is None
+
+
+def test_degrade_then_expand_round_trip_restores_preferred_exactly():
+    pref = {"num-spatial-parts": "4", "slice-method": "horizontal",
+            "batch-size": 4}
+    down = plan_degrade(pref, "sp", "mesh_shrunk",
+                        evidence={"shrunk_spec": "devices=2"})
+    assert down is not None
+    assert required_devices(down.flags, "sp") <= 2
+    assert down.flags != pref
+    up = plan_expand(down.flags, pref, "sp", devices=8)
+    assert up is not None
+    assert up.flags == pref  # byte-identical round trip
+    assert up.env.get("MPI4DL_STRIPE_BWD") == "0"
+
+
+# ---------------------------------------------------------------------------
+# Fleet knobs + job spec validation
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_knobs_env_and_explicit_precedence(monkeypatch):
+    assert fleet_knobs_from_env() == {"devices": 8, "poison_attempts": 2}
+    monkeypatch.setenv("MPI4DL_FLEET_DEVICES", "16")
+    monkeypatch.setenv("MPI4DL_FLEET_POISON_ATTEMPTS", "3")
+    assert fleet_knobs_from_env() == {"devices": 16, "poison_attempts": 3}
+    assert fleet_knobs_from_env(4, 1) == {"devices": 4, "poison_attempts": 1}
+
+
+def test_fleet_job_id_must_be_namespace_safe():
+    for bad in ("", "has space", "-leading", "a/b", "dot..ok but/slash"):
+        with pytest.raises(ValueError):
+            FleetJob(bad, "sp", dict(_SP4))
+    FleetJob("ok-id_1.x", "sp", dict(_SP4))  # does not raise
+
+
+def test_lifecycle_tables_are_closed_and_terminal():
+    assert set(_TRANSITIONS) == set(JOB_STATES)
+    for state, nexts in _TRANSITIONS.items():
+        assert set(nexts) <= set(JOB_STATES)
+        if state in TERMINAL_STATES:
+            assert nexts == ()
+
+
+# ---------------------------------------------------------------------------
+# FleetScheduler with fake leg launchers
+# ---------------------------------------------------------------------------
+
+
+class _FakeProc:
+    """Stands in for the leg Popen: the runtime's drain SIGTERMs it."""
+
+    def __init__(self):
+        self._done = threading.Event()
+
+    def poll(self):
+        return 0 if self._done.is_set() else None
+
+    def terminate(self):
+        self._done.set()
+
+    def wait_terminated(self, timeout):
+        return self._done.wait(timeout)
+
+
+def _final(job, *, loss=1.0, start=0, step=4, elastic=False, **extra):
+    return {"loss": loss, "final_step": step, "start_step": start,
+            "elastic": elastic, "fleet_job": job, **extra}
+
+
+def _instant_factory(calls=None):
+    """Every leg succeeds immediately, tagged with its own job id."""
+
+    def factory(family, model, workdir, *, job, on_spawn):
+        def launch(flags, env, attempt):
+            if calls is not None:
+                calls.append(
+                    {"job": job, "flags": dict(flags), "env": dict(env),
+                     "attempt": attempt})
+            return LegOutcome(rc=0, result=_final(job))
+
+        return launch
+
+    return factory
+
+
+def _events(res, event):
+    return [r for r in res.timeline if r.get("event") == event]
+
+
+def test_scheduler_admits_degraded_on_a_tight_pool(tmp_path):
+    calls = []
+    sched = FleetScheduler(str(tmp_path), devices=2, linger_s=0.2,
+                           launcher_factory=_instant_factory(calls))
+    sched.submit(FleetJob("tight", "sp", dict(_SP4)))
+    res = sched.run(deadline_s=60)
+    assert res.ok and res.jobs["tight"]["state"] == "done"
+    admit = _events(res, "admit")[0]
+    assert admit["degraded"] is True
+    assert admit["degrade_rungs"] == ["stripe_bwd", "shrink_sp"]
+    launch = _events(res, "launch")[0]
+    assert launch["geometry"]["num-spatial-parts"] == "2"
+    assert launch["env"]["MPI4DL_FLEET_SLICE_DEVICES"] == "2"
+    assert launch["env"]["MPI4DL_STRIPE_BWD"] == "1"
+    # The leg really saw the pinned slice size and the degrade env.
+    assert calls[0]["env"]["MPI4DL_FLEET_SLICE_DEVICES"] == "2"
+    # Finished away from its preferred geometry -> reported degraded.
+    assert res.jobs["tight"]["degraded"] is True
+    assert res.jobs["tight"]["fleet_job_tag"] == "tight"
+    assert res.summary["ok"] is True and res.summary["pool"] == 2
+
+
+def test_scheduler_rejects_duplicate_ids_and_fails_unschedulable(tmp_path):
+    sched = FleetScheduler(str(tmp_path), devices=2, linger_s=0.2,
+                           launcher_factory=_instant_factory())
+    sched.submit(FleetJob("dup", "sp", dict(_SP4)))
+    sched.submit(FleetJob("dup", "sp", dict(_SP4)))
+    # An LP job with no ladder below 4 devices cannot ever fit pool=2:
+    # failed loudly, not queued forever.
+    sched.submit(FleetJob("wedged", "lp",
+                          {"split-size": 4, "parts": 1, "batch-size": 4}))
+    res = sched.run(deadline_s=60)
+    rejects = _events(res, "reject")
+    assert len(rejects) == 1 and "duplicate" in rejects[0]["note"]
+    assert res.jobs["dup"]["state"] == "done"
+    assert res.jobs["wedged"]["state"] == "failed"
+    assert _events(res, "unschedulable")
+    assert res.ok is False  # a failed job fails the fleet
+
+
+def test_illegal_lifecycle_transition_raises(tmp_path):
+    sched = FleetScheduler(str(tmp_path), devices=4,
+                           launcher_factory=_instant_factory())
+    sched._handle_submit(FleetJob("x", "sp", dict(_SP4)))
+    js = sched._jobs["x"]
+    js.state = "done"
+    with pytest.raises(RuntimeError, match="illegal fleet transition"):
+        sched._transition(js, "running", event="bogus")
+
+
+def test_priority_preemption_drains_then_resumes_the_victim(tmp_path):
+    """Two high-priority arrivals storm a full pool: the low-priority
+    tenant drains gracefully (SIGTERM -> checkpointed preempted leg),
+    waits out both, and resumes with its progress intact."""
+    box = {}
+    lo_runs = []
+
+    def factory(family, model, workdir, *, job, on_spawn):
+        def launch(flags, env, attempt):
+            if job != "lo":
+                return LegOutcome(rc=0, result=_final(job))
+            lo_runs.append(attempt)
+            if len(lo_runs) == 1:
+                proc = _FakeProc()
+                on_spawn(proc)
+                box["sched"].submit(FleetJob("hi1", "sp", dict(_SP4),
+                                             priority=10))
+                box["sched"].submit(FleetJob("hi2", "sp", dict(_SP4),
+                                             priority=9))
+                assert proc.wait_terminated(30), "drain never SIGTERMed leg"
+                return LegOutcome(
+                    rc=0, result=_final(job, step=2, preempted=True))
+            return LegOutcome(rc=0, result=_final(job, start=2))
+
+        return launch
+
+    sched = FleetScheduler(str(tmp_path), devices=4, linger_s=0.2,
+                           launcher_factory=factory)
+    box["sched"] = sched
+    sched.submit(FleetJob("lo", "sp", dict(_SP4), priority=0))
+    res = sched.run(deadline_s=120)
+    assert res.ok, res.summary
+    assert {j: res.jobs[j]["state"] for j in res.jobs} == {
+        "lo": "done", "hi1": "done", "hi2": "done"}
+    pre = _events(res, "preempt")
+    assert pre and pre[0]["job"] == "lo" and pre[0]["by"] == "hi1"
+    assert res.jobs["lo"]["displaced"] is True
+    assert res.jobs["lo"]["launches"] == 2
+    assert res.jobs["lo"]["start_step"] == 2  # resumed, not restarted
+    assert res.jobs["hi1"]["launches"] == 1
+    assert res.jobs["hi2"]["launches"] == 1
+    # The graceful path left a typed trail: drain -> drained -> requeue.
+    assert any(r["state_to"] == "preempting" for r in _events(res, "drain"))
+    drained = [r for r in _events(res, "drained") if r["job"] == "lo"]
+    assert drained and drained[0]["state_to"] == "queued"
+
+
+def test_poison_job_is_quarantined_without_starving_the_queue(tmp_path):
+    def factory(family, model, workdir, *, job, on_spawn):
+        def launch(flags, env, attempt):
+            if job == "poison":
+                return LegOutcome(rc=1, stderr_tail="synthetic wreck")
+            return LegOutcome(rc=0, result=_final(job))
+
+        return launch
+
+    sched = FleetScheduler(str(tmp_path), devices=4, linger_s=0.2,
+                           launcher_factory=factory)
+    # Higher priority than the steady tenant: without containment it would
+    # monopolize the pool with doomed relaunches forever.
+    sched.submit(FleetJob("poison", "sp", dict(_SP4), priority=5,
+                          max_attempts=1))
+    sched.submit(FleetJob("steady", "sp", dict(_SP4), priority=0))
+    res = sched.run(deadline_s=120)
+    assert res.ok, res.summary  # quarantined != failed: the fleet is OK
+    assert res.jobs["poison"]["state"] == "quarantined"
+    assert res.jobs["poison"]["failures"] == 2  # MPI4DL_FLEET_POISON_ATTEMPTS
+    assert res.jobs["poison"]["launches"] == 2
+    assert res.jobs["steady"]["state"] == "done"
+    assert _events(res, "requeue") and _events(res, "quarantine")
+    # The steady tenant ran after containment, not never.
+    order = [r["event"] for r in res.timeline]
+    assert order.index("quarantine") < len(order)
+
+
+def test_slice_loss_displaces_and_readmits_degraded(tmp_path):
+    box = {}
+    keeper_release = threading.Event()
+
+    def factory(family, model, workdir, *, job, on_spawn):
+        def launch(flags, env, attempt):
+            if job != "nomad":
+                # Hold the slice until nomad has re-admitted, so nomad's
+                # only option really is the 2 surviving free devices.
+                assert keeper_release.wait(60), "nomad never relaunched"
+                return LegOutcome(rc=0, result=_final(job))
+            if "nomad" not in box:
+                box["nomad"] = True
+                proc = _FakeProc()
+                on_spawn(proc)
+                box["sched"].shrink_pool(6)  # devices 6-7 die under us
+                assert proc.wait_terminated(30), "slice loss never drained"
+                return LegOutcome(
+                    rc=0, result=_final(job, step=2, preempted=True))
+            keeper_release.set()
+            return LegOutcome(rc=0, result=_final(job, start=2,
+                                                  elastic=True))
+
+        return launch
+
+    sched = FleetScheduler(str(tmp_path), devices=8, linger_s=0.2,
+                           launcher_factory=factory)
+    box["sched"] = sched
+    sched.submit(FleetJob("keeper", "sp", dict(_SP4), priority=1))
+    sched.submit(FleetJob("nomad", "sp", dict(_SP4), priority=0))
+    res = sched.run(deadline_s=120)
+    assert res.ok, res.summary
+    disp = _events(res, "displaced")
+    assert disp and disp[0]["job"] == "nomad"
+    assert disp[0]["lost_devices"] == [6, 7]
+    assert res.jobs["nomad"]["displaced"] is True
+    assert res.jobs["nomad"]["state"] == "done"
+    assert res.jobs["nomad"]["elastic"] is True
+    # Re-admitted onto the 2 surviving free devices at a shrunk geometry.
+    relaunch = _events(res, "launch")[-1]
+    assert relaunch["job"] == "nomad"
+    assert relaunch["geometry"]["num-spatial-parts"] == "2"
+    # The bystander kept its slice: untouched, one launch.
+    assert res.jobs["keeper"]["displaced"] is False
+    assert res.jobs["keeper"]["launches"] == 1
+
+
+def test_pool_growth_reexpands_degraded_job_to_preferred(tmp_path):
+    box = {}
+
+    def factory(family, model, workdir, *, job, on_spawn):
+        def launch(flags, env, attempt):
+            if "grown" not in box:
+                box["grown"] = True
+                proc = _FakeProc()
+                on_spawn(proc)
+                # The expansion gate waits for a checkpoint at the CURRENT
+                # geometry — write one like a real leg would.
+                os.makedirs(os.path.join(flags["checkpoint-dir"], "ckpt_2"),
+                            exist_ok=True)
+                box["sched"].grow_pool(8)
+                assert proc.wait_terminated(30), "expansion never drained"
+                return LegOutcome(
+                    rc=0, result=_final(job, step=2, preempted=True))
+            return LegOutcome(rc=0, result=_final(job, start=2,
+                                                  elastic=True))
+
+        return launch
+
+    sched = FleetScheduler(str(tmp_path), devices=2, linger_s=0.2,
+                           launcher_factory=factory)
+    box["sched"] = sched
+    sched.submit(FleetJob("sprout", "sp", dict(_SP4)))
+    res = sched.run(deadline_s=120)
+    assert res.ok, res.summary
+    j = res.jobs["sprout"]
+    assert j["state"] == "done" and j["launches"] == 2
+    assert j["expanded"] is True
+    assert j["degraded"] is False  # back at the preferred geometry
+    assert j["final_flags"] == _SP4
+    planned = _events(res, "expand_planned")
+    assert planned and planned[0]["job"] == "sprout"
+    assert planned[0]["rungs"] == ["unstripe_bwd", "grow_sp"]
+    launches = _events(res, "launch")
+    assert launches[0]["env"]["MPI4DL_FLEET_SLICE_DEVICES"] == "2"
+    assert launches[0]["env"]["MPI4DL_STRIPE_BWD"] == "1"
+    assert launches[1]["env"]["MPI4DL_FLEET_SLICE_DEVICES"] == "4"
+    assert launches[1]["env"]["MPI4DL_STRIPE_BWD"] == "0"
+    admit2 = _events(res, "admit")[-1]
+    assert admit2["expanded"] is True
+    assert admit2["expand_rungs"] == ["unstripe_bwd", "grow_sp"]
+
+
+def test_expansion_waits_for_a_resumable_checkpoint(tmp_path):
+    """The scheduler must NOT drain a degraded job for re-expansion before
+    it has checkpointed at its current geometry: there would be nothing
+    new to elastic-restore from and the leg's compile work would be
+    discarded."""
+    box = {}
+
+    def factory(family, model, workdir, *, job, on_spawn):
+        def launch(flags, env, attempt):
+            if "first" not in box:
+                box["first"] = True
+                proc = _FakeProc()
+                on_spawn(proc)
+                box["sched"].grow_pool(8)
+                # No checkpoint yet: the gate must hold the drain back.
+                assert not proc.wait_terminated(1.0), \
+                    "drained before any resumable checkpoint existed"
+                os.makedirs(os.path.join(flags["checkpoint-dir"], "ckpt_2"),
+                            exist_ok=True)
+                assert proc.wait_terminated(30), "gate never released"
+                return LegOutcome(
+                    rc=0, result=_final(job, step=2, preempted=True))
+            return LegOutcome(rc=0, result=_final(job, start=2,
+                                                  elastic=True))
+
+        return launch
+
+    sched = FleetScheduler(str(tmp_path), devices=2, linger_s=0.2,
+                           launcher_factory=factory)
+    box["sched"] = sched
+    sched.submit(FleetJob("gated", "sp", dict(_SP4)))
+    res = sched.run(deadline_s=120)
+    assert res.ok, res.summary
+    assert res.jobs["gated"]["expanded"] is True
+    order = [r["event"] for r in res.timeline]
+    deferred = order.index("expand_deferred")
+    planned = order.index("expand_planned")
+    assert deferred < planned  # decision trail: deferred, then planned
+
+
+# ---------------------------------------------------------------------------
+# Job-namespaced evidence (zero cross-job contamination)
+# ---------------------------------------------------------------------------
+
+
+def test_contamination_detector_flags_foreign_evidence(tmp_path):
+    legdir = tmp_path / "legs" / "launch001"
+    (legdir / "alpha").mkdir(parents=True)
+    ok = FleetResult(
+        ok=True,
+        jobs={"alpha": {"state": "done", "fleet_job_tag": "alpha"}},
+        timeline=[{"event": "launch", "job": "alpha",
+                   "workdir": str(legdir)}],
+        summary={},
+    )
+    assert _contamination_problems(str(tmp_path), ok) == []
+    # A final summary tagged with ANOTHER job's id is contamination.
+    mislabeled = FleetResult(
+        ok=True,
+        jobs={"alpha": {"state": "done", "fleet_job_tag": "beta"}},
+        timeline=[], summary={},
+    )
+    assert any("alpha" in p for p in
+               _contamination_problems(str(tmp_path), mislabeled))
+    # A foreign namespace inside a launch workdir is contamination.
+    (legdir / "beta").mkdir()
+    assert any("launch001" in p for p in
+               _contamination_problems(str(tmp_path), ok))
+
+
+def test_fleet_run_keeps_every_launch_workdir_job_namespaced(tmp_path):
+    """The real launch layout: legs/<launch>/<job>/attempt<N> per leg and
+    jobs/<id>/supervisorNN.jsonl per run — a two-tenant fleet must leave
+    zero cross-job evidence."""
+
+    def factory(family, model, workdir, *, job, on_spawn):
+        def launch(flags, env, attempt):
+            # Mimic subprocess_leg_launcher's namespaced attempt dirs.
+            os.makedirs(os.path.join(workdir, job, f"attempt{attempt}"),
+                        exist_ok=True)
+            return LegOutcome(rc=0, result=_final(job))
+
+        return launch
+
+    sched = FleetScheduler(str(tmp_path), devices=8, linger_s=0.2,
+                           launcher_factory=factory)
+    sched.submit(FleetJob("alpha", "sp", dict(_SP4)))
+    sched.submit(FleetJob("beta", "sp", dict(_SP4)))
+    res = sched.run(deadline_s=60)
+    assert res.ok
+    assert _contamination_problems(str(tmp_path), res) == []
+    # Supervisor RunLogs live under the owning job's namespace only.
+    for jid in ("alpha", "beta"):
+        jobdir = tmp_path / "jobs" / jid
+        logs = sorted(p.name for p in jobdir.glob("supervisor*.jsonl"))
+        assert logs == ["supervisor01.jsonl"]
+
+
+# ---------------------------------------------------------------------------
+# Scenario harness (fake legs) + drill matrix sanity
+# ---------------------------------------------------------------------------
+
+
+def test_run_fleet_scenario_judges_with_fake_legs(tmp_path):
+    sc = FleetScenario(
+        "fake_solo", pool=4,
+        jobs=(FleetJob("solo", "sp", dict(_SP4)),),
+        expect_done=("solo",), verify_loss=("solo",), deadline_s=60,
+    )
+    v = run_fleet_scenario(sc, str(tmp_path),
+                           launcher_factory=_instant_factory())
+    assert v.passed, v.details
+    assert v.kind == "verified_recovery"
+    assert v.details["final_loss_solo"] == 1.0
+    assert v.details["control_loss_solo"] == 1.0
+
+    def broken(family, model, workdir, *, job, on_spawn):
+        def launch(flags, env, attempt):
+            return LegOutcome(rc=1, stderr_tail="dead on arrival")
+
+        return launch
+
+    sc2 = FleetScenario(
+        "fake_dead", pool=4,
+        jobs=(FleetJob("solo", "sp", dict(_SP4), max_attempts=1),),
+        expect_done=("solo",), deadline_s=60,
+    )
+    v2 = run_fleet_scenario(sc2, str(tmp_path), launcher_factory=broken)
+    assert not v2.passed and v2.kind == "not_recovered"
+
+
+def test_fleet_scenarios_matrix_is_well_formed():
+    scs = fleet_scenarios()
+    assert [s.name for s in scs] == [
+        "fleet_slice_kill", "fleet_preempt_storm", "fleet_crash_cascade",
+        "fleet_oom_poison", "fleet_reexpand",
+    ]
+    for sc in scs:
+        ids = {j.id for j in sc.jobs}
+        for field in ("expect_done", "expect_quarantined",
+                      "expect_displaced", "expect_untouched",
+                      "expect_expanded", "expect_resumed",
+                      "require_elastic", "verify_loss",
+                      "expect_desynced_backoff"):
+            expected = set(getattr(sc, field))
+            # Triggers may submit extra jobs mid-run (the preempt storm);
+            # statically-declared jobs must at least cover the fault axis.
+            if field in ("expect_displaced", "expect_untouched",
+                         "expect_quarantined", "require_elastic"):
+                assert expected <= ids, (sc.name, field)
+        # Every scenario's statically-submitted demand has SOME ladder
+        # geometry that fits its pool (else it would be unschedulable).
+        for j in sc.jobs:
+            need = required_devices(j.flags, j.family)
+            fits = need <= sc.pool or plan_degrade(
+                j.flags, j.family, "mesh_shrunk",
+                evidence={"shrunk_spec": f"devices={sc.pool}"},
+            ) is not None
+            assert fits or j.id == "poison", (sc.name, j.id)
+
+
+@pytest.mark.slow
+def test_fleet_crash_cascade_end_to_end(tmp_path):
+    """Real subprocess legs on the CPU virtual mesh: two tenants hit the
+    same transient-I/O fault, both recover, and their retry backoffs are
+    de-synchronized by the per-(job, attempt) jitter."""
+    sc = next(s for s in fleet_scenarios()
+              if s.name == "fleet_crash_cascade")
+    v = run_fleet_scenario(sc, str(tmp_path), log=print)
+    assert v.passed, (v.kind, v.details)
+    assert v.kind == "verified_recovery"
+    seqs = v.details["backoff_s"]
+    assert seqs["alpha"] and seqs["beta"]
+    assert seqs["alpha"] != seqs["beta"]

@@ -325,16 +325,17 @@ def test_subprocess_launcher_reads_flight_dump(tmp_path, monkeypatch):
     from mpi4dl_tpu.resilience.supervisor import subprocess_leg_launcher
 
     class _Proc:
-        returncode = HANG_EXIT_CODE
+        def wait(self, timeout=None):
+            return HANG_EXIT_CODE
 
-    def fake_run(cmd, env=None, **kw):
+    def fake_popen(cmd, env=None, **kw):
         # the leg "dumped" a flight record into its attempt dir before dying
         adir = os.path.dirname(env["MPI4DL_CRASH_MARKER"])
         with open(os.path.join(adir, FLIGHT_BASENAME), "w") as fh:
             json.dump(_flight_doc(phase="save"), fh)
         return _Proc()
 
-    monkeypatch.setattr(_subprocess, "run", fake_run)
+    monkeypatch.setattr(_subprocess, "Popen", fake_popen)
     launch = subprocess_leg_launcher("sp", "resnet", str(tmp_path))
     out = launch({}, {}, 1)
     assert out.flight is not None and out.flight["phase"] == "save"

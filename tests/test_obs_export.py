@@ -319,6 +319,106 @@ def test_metrics_cli_and_file_sink(tmp_path, capsys):
     assert open(p).read().endswith("# EOF\n")
 
 
+def _write_job_runlog(path, records):
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with open(path, "w") as fh:
+        for r in records:
+            fh.write(json.dumps(r) + "\n")
+    return path
+
+
+def test_metrics_runlogs_aggregation_job_labels(tmp_path):
+    """ISSUE 18 satellite: many RunLogs -> ONE exposition, each family
+    declared once, every sample labeled job="<id>" (fleet layout stems
+    collide, so the parent dir names the job)."""
+    from mpi4dl_tpu.obs.metrics import metrics_from_runlogs
+
+    a = _write_job_runlog(tmp_path / "jobs" / "alpha" / "supervisor00.jsonl",
+                      _metrics_records())
+    b = _write_job_runlog(tmp_path / "jobs" / "beta" / "supervisor00.jsonl",
+                      _metrics_records())
+    text = metrics_from_runlogs([str(a), str(b)])
+    families, samples = _parse_exposition(text)
+    # one declaration per family even with two sources
+    assert text.count("# TYPE mpi4dl_step_latency_ms ") == 1
+    assert families["mpi4dl_step_latency_ms"] == "summary"
+    for job in ("alpha", "beta"):
+        assert samples[("mpi4dl_step_latency_ms_count",
+                        f'job="{job}"')] == 4
+        assert samples[("mpi4dl_supervisor_ok", f'job="{job}"')] == 1
+        assert samples[("mpi4dl_supervisor_incidents_total",
+                        f'class="hang",job="{job}"')] == 1
+    # explicit mapping form wins over inference
+    text = metrics_from_runlogs({"j1": str(a)})
+    _, samples = _parse_exposition(text)
+    assert ("mpi4dl_steps_total", 'job="j1"') in samples
+
+
+def test_metrics_fleet_families(tmp_path):
+    """fleet / fleet_summary records render as labeled fleet families."""
+    recs = [
+        {"kind": "fleet", "t": 0.1, "event": "submit", "job": "a"},
+        {"kind": "fleet", "t": 0.2, "event": "admit", "job": "a"},
+        {"kind": "fleet", "t": 0.3, "event": "admit", "job": "b"},
+        {"kind": "fleet", "t": 0.4, "event": "preempt", "job": "b"},
+        {"kind": "fleet_summary", "t": 1.0, "ok": True,
+         "jobs": {"a": "done", "b": "done", "c": "quarantined"},
+         "pool": 8, "events": 4},
+    ]
+    text = metrics_from_records(recs)
+    families, samples = _parse_exposition(text)
+    assert families["mpi4dl_fleet_events"] == "counter"
+    assert samples[("mpi4dl_fleet_events_total", 'event="admit"')] == 2
+    assert samples[("mpi4dl_fleet_events_total", 'event="preempt"')] == 1
+    assert samples[("mpi4dl_fleet_ok", "")] == 1
+    assert samples[("mpi4dl_fleet_jobs", 'state="done"')] == 2
+    assert samples[("mpi4dl_fleet_jobs", 'state="quarantined"')] == 1
+
+
+def test_serve_metrics_multi_source_single_port(tmp_path):
+    """The fleet's jobs scrape from ONE endpoint: serve_metrics over a
+    sequence of runlogs serves the aggregated job-labeled exposition."""
+    a = _write_job_runlog(tmp_path / "jobs" / "alpha" / "supervisor00.jsonl",
+                      _metrics_records())
+    b = _write_job_runlog(tmp_path / "jobs" / "beta" / "supervisor00.jsonl",
+                      _metrics_records())
+    srv = serve_metrics([str(a), str(b)], 0)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        port = srv.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics") as resp:
+            assert resp.status == 200
+            body = resp.read().decode("utf-8")
+        assert 'job="alpha"' in body and 'job="beta"' in body
+        assert body.count("# TYPE mpi4dl_steps ") == 1
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        t.join(timeout=5)
+
+
+def test_metrics_cli_dir_expands_to_aggregation(tmp_path, capsys):
+    """`obs metrics DIR` globs every *.jsonl under it recursively and
+    emits one job-labeled exposition; --out stays atomic."""
+    _write_job_runlog(tmp_path / "fleet.jsonl",
+                  [{"kind": "fleet_summary", "t": 1.0, "ok": True,
+                    "jobs": {"a": "done"}, "pool": 8, "events": 1}])
+    _write_job_runlog(tmp_path / "jobs" / "alpha" / "supervisor00.jsonl",
+                  _metrics_records())
+    _write_job_runlog(tmp_path / "jobs" / "beta" / "supervisor00.jsonl",
+                  _metrics_records())
+    assert obs_main(["metrics", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert 'job="alpha"' in out and 'job="beta"' in out
+    assert 'job="fleet"' in out and "mpi4dl_fleet_ok" in out
+    dest = tmp_path / "fleet.prom"
+    assert obs_main(["metrics", str(tmp_path), "--out", str(dest)]) == 0
+    assert dest.read_text().endswith("# EOF\n")
+    assert obs_main(["metrics", str(tmp_path / "empty_nowhere")]) == 2
+
+
 def test_serve_metrics_scrape(tmp_path):
     rl = tmp_path / "m.jsonl"
     with open(rl, "w") as fh:

@@ -274,6 +274,29 @@ def test_backoff_deterministic_bounded_and_jittered():
     assert backoff_delay(3, seed=1) != backoff_delay(3, seed=2)
 
 
+def test_backoff_job_key_desynchronizes_fleet_tenants():
+    """ISSUE 18 satellite: two fleet jobs sharing ONE seed must not retry
+    in lockstep — the jitter draw is keyed by (job id, seed, attempt)."""
+    alpha = [backoff_delay(i, base=1.0, cap=30.0, seed=7, job="alpha")
+             for i in range(1, 6)]
+    beta = [backoff_delay(i, base=1.0, cap=30.0, seed=7, job="beta")
+            for i in range(1, 6)]
+    assert alpha != beta  # same seed, different tenants: de-synchronized
+    assert all(x != y for x, y in zip(alpha, beta))  # at every attempt
+    # ...but each tenant's own schedule is reproducible,
+    assert alpha == [backoff_delay(i, base=1.0, cap=30.0, seed=7,
+                                   job="alpha") for i in range(1, 6)]
+    # bounded exactly like the solo supervisor's,
+    for i, d in enumerate(alpha, start=1):
+        raw = min(30.0, 2.0 ** (i - 1))
+        assert raw * 0.75 <= d <= raw * 1.25
+    # and job="" (no fleet) reproduces the legacy pre-fleet sequence.
+    legacy = [backoff_delay(i, base=1.0, cap=30.0, seed=7)
+              for i in range(1, 6)]
+    assert [backoff_delay(i, base=1.0, cap=30.0, seed=7, job="")
+            for i in range(1, 6)] == legacy
+
+
 # ---------------------------------------------------------------------------
 # Planner: ladder order, elasticity awareness, feasibility
 # ---------------------------------------------------------------------------
