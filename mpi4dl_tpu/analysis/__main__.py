@@ -13,6 +13,10 @@ goldens; see its ``--help``).  ``python -m mpi4dl_tpu.analysis ircheck
 ...`` dispatches to the IR-level shard-flow verifier (analysis/ircheck —
 replication flow, collective matching, donation safety, async
 well-formedness over the same engine builds; see its ``--help``).
+``python -m mpi4dl_tpu.analysis pallascheck ...`` dispatches to the static
+Pallas kernel verifier (analysis/pallascheck — grid/BlockSpec soundness,
+VMEM budget certification, DMA/semaphore discipline and accumulator-init
+coverage over every kernel in ops/kernel_registry; see its ``--help``).
 """
 
 from __future__ import annotations
@@ -117,6 +121,12 @@ def main(argv=None) -> int:
         from mpi4dl_tpu.analysis.ircheck.__main__ import main as ircheck_main
 
         return ircheck_main(argv[1:])
+    if argv and argv[0] == "pallascheck":
+        from mpi4dl_tpu.analysis.pallascheck.__main__ import (
+            main as pallascheck_main,
+        )
+
+        return pallascheck_main(argv[1:])
 
     ap = argparse.ArgumentParser(
         prog="python -m mpi4dl_tpu.analysis",
@@ -180,7 +190,7 @@ def main(argv=None) -> int:
     # Subcommands dispatch only as the FIRST token; a flag-first spelling
     # (`--json contracts`) would otherwise be treated as a scan path with
     # no .py files in it and exit 0 looking like a passed gate.
-    for sub in ("contracts", "ircheck"):
+    for sub in ("contracts", "ircheck", "pallascheck"):
         if sub in args.paths:
             print(
                 f"analysis: `{sub}` must come first: "

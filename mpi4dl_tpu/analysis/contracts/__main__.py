@@ -54,9 +54,12 @@ def main(argv=None) -> int:
     ap.add_argument("--dir", metavar="D", default=None,
                     help="goldens directory (default: <repo>/contracts)")
     ap.add_argument("--engines", metavar="NAMES", default=None,
-                    help="comma-separated subset of engine families "
-                         f"(default: {','.join(ENGINE_FAMILIES)})")
-    ap.add_argument("--section", choices=["overlap"], default=None,
+                    help="comma-separated subset of engine families; the "
+                         "pseudo-family `pallas` selects the Pallas kernel "
+                         "contract alone "
+                         f"(default: {','.join(ENGINE_FAMILIES)} + pallas)")
+    ap.add_argument("--section", choices=["overlap", "pallas"],
+                    default=None,
                     help="restrict drift reporting to one contract section "
                          "(plus meta mismatches); the overlap-contract CI "
                          "job gates on --section overlap so overlap "
@@ -74,12 +77,19 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     families = list(ENGINE_FAMILIES)
+    # The Pallas kernel contract rides as a pseudo-family: no engine build,
+    # its "extraction" traces the kernel registry (skipped under --quant —
+    # the registry already enrolls the quantized kernel variants as their
+    # own cases, so there is no separate quant contract set).
+    want_pallas = not args.quant
     if args.engines:
         families = [f.strip() for f in args.engines.split(",") if f.strip()]
+        want_pallas = "pallas" in families and not args.quant
+        families = [f for f in families if f != "pallas"]
         unknown = [f for f in families if f not in ENGINE_FAMILIES]
         if unknown:
             print(f"contracts: unknown engine(s) {unknown}; "
-                  f"have {list(ENGINE_FAMILIES)}", file=sys.stderr)
+                  f"have {list(ENGINE_FAMILIES)} + pallas", file=sys.stderr)
             return 2
 
     err = ensure_virtual_mesh(families)
@@ -185,6 +195,47 @@ def main(argv=None) -> int:
                     "version skew, not a code change"
                 )
 
+    if want_pallas:
+        from mpi4dl_tpu.analysis.contracts.diff import diff_pallas_contract
+        from mpi4dl_tpu.analysis.pallascheck import pallas_contract
+
+        current = pallas_contract()
+        path = golden_path(raw_directory, "pallas")
+        if args.update:
+            os.makedirs(raw_directory, exist_ok=True)
+            with open(path, "w", encoding="utf-8") as fh:
+                json.dump(current, fh, indent=1, sort_keys=True)
+                fh.write("\n")
+            if not args.json:
+                print(f"contract written: {path}")
+            report["pallas"] = []
+        elif not os.path.exists(path):
+            report["pallas"] = [{"kind": "meta", "field": "golden",
+                                 "golden": None, "current": path}]
+            if not args.json:
+                print(f"contract MISSING: no golden at {path} "
+                      "(run with --update to create it)")
+            rc = 1
+        else:
+            with open(path, "r", encoding="utf-8") as fh:
+                golden = json.load(fh)
+            drifts = diff_pallas_contract(golden, current)
+            if args.section:
+                drifts = [d for d in drifts
+                          if d["kind"] in ("meta", args.section)]
+            report["pallas"] = drifts
+            if drifts:
+                rc = 1
+            if not args.json:
+                print(render_drift_report("pallas", drifts))
+                if drifts and golden.get("jax") != current.get("jax"):
+                    print(
+                        f"  note: golden was extracted on jax "
+                        f"{golden.get('jax')}, this run is jax "
+                        f"{current.get('jax')} — tracing differences may "
+                        "be version skew, not a code change"
+                    )
+
     payload = json.dumps(
         {"drift": report, **({"quant_ratio": ratio_report}
                              if ratio_report else {})},
@@ -196,8 +247,11 @@ def main(argv=None) -> int:
         with open(args.out, "w", encoding="utf-8") as fh:
             fh.write(payload + "\n")
     if rc == 0 and not args.json and not args.update:
-        print(f"contracts: {len(families)} engine famil"
-              f"{'y' if len(families) == 1 else 'ies'} clean")
+        n = len(families)
+        print(f"contracts: {n} engine famil"
+              f"{'y' if n == 1 else 'ies'}"
+              + (" + pallas kernel contract" if want_pallas else "")
+              + " clean")
     return rc
 
 

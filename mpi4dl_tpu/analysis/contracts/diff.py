@@ -20,6 +20,10 @@ machine-checkable (the CI job uploads it as an artifact on failure):
 - ``ircheck``: the IR verifier's per-kind finding count moved (a clean
   engine pins ``{}``; any growth names the regression class — wasted-wire,
   divergent-collective, read-after-donate, ... — ISSUE 16);
+- ``pallas``: the static Pallas kernel verifier's ``pallas`` section moved
+  for one registered kernel case — grid, a block shape, the re-derived
+  per-grid-point VMEM total, the DMA-start count, or a finding count
+  (clean kernels pin ``{}`` findings; ISSUE 19);
 - ``meta``: schema/engine mismatch (golden unusable — regenerate).
 """
 
@@ -158,6 +162,63 @@ def _diff_overlap(golden: dict, current: dict) -> List[dict]:
 
 
 # ---------------------------------------------------------------------------
+# Pallas kernel contract (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+_PALLAS_FIELDS = ("grid", "vmem_bytes", "dma_starts")
+
+
+def diff_pallas_contract(golden: dict, current: dict) -> List[dict]:
+    """Drift records between a golden and a freshly-extracted ``pallas``
+    contract (:func:`mpi4dl_tpu.analysis.pallascheck.pallas_contract`).
+    Record shape: ``{"kind": "pallas", "kernel": case, "field": ...,
+    "golden": ..., "current": ...}`` (field ``presence`` when a registry
+    case appeared or disappeared)."""
+    drifts: List[dict] = []
+    for field in ("schema", "vmem_frac"):
+        if golden.get(field) != current.get(field):
+            drifts.append({
+                "kind": "meta", "field": field,
+                "golden": golden.get(field), "current": current.get(field),
+            })
+    if drifts:
+        return drifts
+    g_k = golden.get("kernels", {})
+    c_k = current.get("kernels", {})
+    for name in sorted(set(g_k) | set(c_k)):
+        if name not in c_k or name not in g_k:
+            drifts.append({
+                "kind": "pallas", "kernel": name, "field": "presence",
+                "golden": name in g_k, "current": name in c_k,
+            })
+            continue
+        g, c = g_k[name], c_k[name]
+        for field in _PALLAS_FIELDS:
+            if g.get(field) != c.get(field):
+                drifts.append({
+                    "kind": "pallas", "kernel": name, "field": field,
+                    "golden": g.get(field), "current": c.get(field),
+                })
+        g_b, c_b = g.get("blocks", {}), c.get("blocks", {})
+        for op in sorted(set(g_b) | set(c_b)):
+            if g_b.get(op) != c_b.get(op):
+                drifts.append({
+                    "kind": "pallas", "kernel": name,
+                    "field": f"blocks.{op}",
+                    "golden": g_b.get(op), "current": c_b.get(op),
+                })
+        g_f, c_f = g.get("findings", {}), c.get("findings", {})
+        for kind in sorted(set(g_f) | set(c_f)):
+            if g_f.get(kind, 0) != c_f.get(kind, 0):
+                drifts.append({
+                    "kind": "pallas", "kernel": name,
+                    "field": f"findings.{kind}",
+                    "golden": g_f.get(kind, 0), "current": c_f.get(kind, 0),
+                })
+    return drifts
+
+
+# ---------------------------------------------------------------------------
 # Quantized-contract byte-ratio gate (ISSUE 10)
 # ---------------------------------------------------------------------------
 
@@ -289,6 +350,19 @@ def render_drift_report(engine: str, drifts: List[dict]) -> str:
                 f"  ircheck finding {d['finding']}: count "
                 f"{_fmt_delta(d['count_golden'], d['count_current'])} — "
                 "run `python -m mpi4dl_tpu.analysis ircheck` for details"
+            )
+        elif kind == "pallas":
+            extra = ""
+            if d["field"].startswith("findings."):
+                extra = (" — run `python -m mpi4dl_tpu.analysis "
+                         "pallascheck` for details")
+            elif d["field"] == "presence":
+                extra = (" — registry case "
+                         + ("REMOVED" if d["golden"] else "ADDED")
+                         + "; regenerate with --update if intended")
+            lines.append(
+                f"  pallas kernel {d['kernel']}: {d['field']} "
+                f"golden {d['golden']} vs current {d['current']}{extra}"
             )
         elif kind == "sharding":
             if "count_golden" in d:

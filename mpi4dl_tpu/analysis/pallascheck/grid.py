@@ -1,0 +1,175 @@
+"""Grid/BlockSpec soundness: the (a) checks.
+
+Enumerates the FULL grid (registry shapes keep grids tiny) in the TPU's
+sequential row-major order and evaluates every blocked operand's index map
+at every point.  With Pallas ``Blocked`` indexing the element offset of a
+block is ``index * block_shape``, so distinct indices can never partially
+overlap — the output hazards are therefore *revisit structure* hazards:
+identical consecutive indices are the legal accumulation pattern, identical
+NON-consecutive indices clobber already-emitted data (``overlapping-
+output``), and indices that never cover part of the array leave garbage in
+HBM (``untiled-output``).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from mpi4dl_tpu.analysis.pallascheck import Finding, point_class
+from mpi4dl_tpu.analysis.pallascheck.trace import (
+    KernelSpec, Operand, eval_index_map,
+)
+
+_LANES = 128
+
+
+def grid_points(grid) -> List[Tuple[int, ...]]:
+    """Every grid point in execution order (row-major, last dim innermost —
+    the TPU's sequential grid semantics, which the scratch-persistence and
+    revisit checks depend on)."""
+    return list(itertools.product(*(range(int(g)) for g in grid)))
+
+
+def block_offsets(spec: KernelSpec) -> Dict[int, List[Optional[Tuple[int, ...]]]]:
+    """Per blocked operand (by kernel-invar pos), the block-index tuple at
+    every grid point in execution order (None where the map is not
+    statically evaluable)."""
+    points = grid_points(spec.grid)
+    out: Dict[int, List[Optional[Tuple[int, ...]]]] = {}
+    for op in spec.operands:
+        if not op.blocked:
+            continue
+        out[op.pos] = [eval_index_map(op.index_map, p) for p in points]
+    return out
+
+
+def _sublane_multiple(dtype) -> int:
+    # 8 rows at 4-byte types, 16 at 2-byte, 32 at 1-byte (packed tiling).
+    return max(1, 32 // max(1, np.dtype(dtype).itemsize))
+
+
+def _alignment_findings(spec: KernelSpec, op: Operand) -> List[Finding]:
+    """Lane/sublane tiling of the minor two block dims.  A dim of 1 (a
+    squeezed leading block dim) and a dim equal to the full array extent
+    are both fine — Mosaic handles whole-axis and singleton blocks; what it
+    cannot tile is a PARTIAL block off the (sublane, lane) grid."""
+    out: List[Finding] = []
+    if len(op.shape) < 1:
+        return out
+    arr = op.array_shape or op.shape
+    checks = [(-1, _LANES, "lane")]
+    if len(op.shape) >= 2:
+        checks.append((-2, _sublane_multiple(op.dtype), "sublane"))
+    for axis, mult, label in checks:
+        dim = int(op.shape[axis])
+        full = int(arr[axis]) if len(arr) >= -axis else dim
+        if dim != 1 and dim != full and dim % mult:
+            out.append(Finding(
+                kind="misaligned-block",
+                kernel=spec.case,
+                grid_class="",
+                message=(
+                    f"{op.name}: block dim {dim} on the {label} axis is "
+                    f"neither the full array extent ({full}) nor a "
+                    f"multiple of the {mult}-row {label} tiling for "
+                    f"{np.dtype(op.dtype).name}"
+                ),
+            ))
+    return out
+
+
+def grid_findings(spec: KernelSpec) -> List[Finding]:
+    points = grid_points(spec.grid)
+    offsets = block_offsets(spec)
+    out: List[Finding] = []
+    seen: set = set()
+
+    def emit(kind: str, cls: str, message: str) -> None:
+        if (kind, cls) not in seen:
+            seen.add((kind, cls))
+            out.append(Finding(kind=kind, kernel=spec.case,
+                               grid_class=cls, message=message))
+
+    for op in spec.operands:
+        if not op.blocked:
+            continue
+        out.extend(_alignment_findings(spec, op))
+        offs = offsets[op.pos]
+        arr = op.array_shape
+        if arr is None:
+            continue
+        # (1) in-bounds at every grid point
+        for point, off in zip(points, offs):
+            if off is None:
+                continue
+            if len(off) != len(op.shape):
+                continue
+            for o, bs, ad in zip(off, op.shape, arr):
+                if o < 0 or o * bs + bs > ad:
+                    emit(
+                        "oob-block", point_class(spec.grid, point),
+                        f"{op.name}: block index {tuple(off)} places a "
+                        f"{tuple(op.shape)} block outside the "
+                        f"{tuple(arr)} array at grid point {point}",
+                    )
+                    break
+        if op.role != "out":
+            continue
+        # (2) output revisit structure: non-consecutive revisit = clobber
+        first_at: Dict[Tuple[int, ...], int] = {}
+        last_at: Dict[Tuple[int, ...], int] = {}
+        for t, off in enumerate(offs):
+            if off is None:
+                continue
+            if off in last_at and last_at[off] != t - 1:
+                emit(
+                    "overlapping-output",
+                    point_class(spec.grid, points[t]),
+                    f"{op.name}: output block {off} is revisited "
+                    f"non-consecutively (grid steps {last_at[off]} and "
+                    f"{t}) — the block emitted after the first visit run "
+                    "is clobbered by the second",
+                )
+            if off not in first_at:
+                first_at[off] = t
+            last_at[off] = t
+        # (3) coverage: every block of the output array must be visited
+        if all(o is not None for o in offs) and len(arr) == len(op.shape):
+            want = itertools.product(
+                *(range(-(-int(ad) // int(bs)))
+                  for ad, bs in zip(arr, op.shape))
+            )
+            missing = [w for w in want if w not in first_at]
+            if missing:
+                emit(
+                    "untiled-output", "",
+                    f"{op.name}: {len(missing)} block(s) of the "
+                    f"{tuple(arr)} output (first: {missing[0]}) are never "
+                    "written by any grid point — uninitialized HBM reaches "
+                    "the caller",
+                )
+    return out
+
+
+def output_runs(spec: KernelSpec) -> List[int]:
+    """For each grid point (execution order), the id of the output visit
+    run it belongs to: a run is a maximal stretch of consecutive points
+    whose EVERY output block index is unchanged.  Runs longer than one
+    point are the accumulation pattern the ``uninit-accumulator`` check
+    audits (interp.py)."""
+    points = grid_points(spec.grid)
+    offsets = block_offsets(spec)
+    outs = [op.pos for op in spec.outputs if op.pos in offsets]
+    runs: List[int] = []
+    run = 0
+    prev = None
+    for t in range(len(points)):
+        sig = tuple(offsets[p][t] for p in outs)
+        if prev is not None and (sig != prev or None in sig):
+            run += 1
+        runs.append(run)
+        prev = sig
+    return runs

@@ -9,6 +9,7 @@ from mpi4dl_tpu.analysis.core import Rule
 from mpi4dl_tpu.analysis.rules_collective import RULE as _collective
 from mpi4dl_tpu.analysis.rules_dtype import RULE as _dtype
 from mpi4dl_tpu.analysis.rules_env import RULE as _env
+from mpi4dl_tpu.analysis.rules_pallas import RULE as _pallas
 from mpi4dl_tpu.analysis.rules_print import RULE as _print
 from mpi4dl_tpu.analysis.rules_quant import RULE as _quant
 from mpi4dl_tpu.analysis.rules_retrace import RULE as _retrace
@@ -28,6 +29,7 @@ RULE_TABLE: List[Rule] = [
     _thread,
     _scope,
     _quant,
+    _pallas,
 ]
 
 RULES_BY_NAME: Dict[str, Rule] = {r.name: r for r in RULE_TABLE}

@@ -1,0 +1,127 @@
+"""Rule ``unregistered-pallas-call`` (rule 12): every Pallas kernel must be
+enrolled in the pallascheck gate.
+
+The static kernel verifier (analysis/pallascheck, docs/analysis.md) only
+certifies what ``mpi4dl_tpu/ops/kernel_registry.py`` enrolls: grid/
+BlockSpec soundness, the per-grid-point VMEM budget, DMA/semaphore
+discipline and accumulator-init coverage are all proved per registered
+case.  A new ``pl.pallas_call`` in a module the registry never imports —
+the exact shape of the future halo-RDMA conv landing as a fresh file —
+would ship with none of those invariants checked and no test failing.
+This rule fails the build at the source level: the fix is one
+``KernelCase`` row (whose module import is itself the registration mark
+this rule checks for).
+
+Scope: ``mpi4dl_tpu`` package files and ``benchmarks/`` (a benchmark
+throwaway kernel that is deliberately not worth a registry row carries
+``# analysis: ok(unregistered-pallas-call)`` with a comment saying why).
+Tests are exempt — pallascheck's own fixture lane defines
+intentionally-broken kernels inline.  The registered-module set is parsed
+statically from the registry's imports (never executed), falling back to
+the installed module when the registry file is outside the scan scope.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from mpi4dl_tpu.analysis.core import (
+    Project,
+    Rule,
+    SourceFile,
+    Violation,
+    _find_file,
+    _parse_fallback,
+    is_package_file,
+)
+
+_REGISTRY_SUFFIX = "mpi4dl_tpu/ops/kernel_registry.py"
+_REGISTRY_MODULE = "mpi4dl_tpu.ops.kernel_registry"
+
+
+def registered_modules(files) -> Set[str]:
+    """Module names the kernel registry imports (statically parsed): the
+    set whose kernels pallascheck discovers and certifies."""
+    src = _find_file(files, _REGISTRY_SUFFIX) or _parse_fallback(
+        _REGISTRY_MODULE
+    )
+    out: Set[str] = set()
+    if src is None:
+        return out
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.ImportFrom) and node.module:
+            out.add(node.module)
+            # `from pkg import mod` also registers pkg.mod
+            for a in node.names:
+                out.add(f"{node.module}.{a.name}")
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                out.add(a.name)
+    return out
+
+
+def _module_name(rel: str) -> Optional[str]:
+    """Dotted module name of a scanned file, rooted at the package."""
+    rel = rel.replace("\\", "/")
+    if "mpi4dl_tpu/" in f"/{rel}":
+        rel = rel[rel.index("mpi4dl_tpu/"):]
+    elif not rel.startswith("mpi4dl_tpu"):
+        return None
+    if rel.endswith(".py"):
+        rel = rel[:-3]
+    if rel.endswith("/__init__"):
+        rel = rel[: -len("/__init__")]
+    return rel.replace("/", ".")
+
+
+def _in_scope(rel: str) -> bool:
+    rel = rel.replace("\\", "/")
+    return is_package_file(rel) or "benchmarks/" in f"/{rel}"
+
+
+class UnregisteredPallasCallRule(Rule):
+    name = "unregistered-pallas-call"
+    description = (
+        "pl.pallas_call in a module the kernel registry (mpi4dl_tpu/ops/"
+        "kernel_registry.py) never imports — the kernel ships outside the "
+        "pallascheck VMEM/DMA/grid gate; add a KernelCase row, or pragma "
+        "a benchmark throwaway"
+    )
+
+    def check(self, project: Project) -> List[Violation]:
+        registered = registered_modules(project.files)
+        out: List[Violation] = []
+        for src in project.files:
+            if not _in_scope(src.rel) or src.rel.endswith(_REGISTRY_SUFFIX):
+                continue
+            mod = _module_name(src.rel)
+            if mod is not None and mod in registered:
+                continue
+            out.extend(self._file_violations(src, mod))
+        return out
+
+    def _file_violations(self, src: SourceFile,
+                         mod: Optional[str]) -> List[Violation]:
+        out: List[Violation] = []
+        for node in src.nodes(ast.Call):
+            resolved = src.resolve(node.func) or ""
+            if not resolved.endswith("pallas_call"):
+                continue
+            where = mod or src.rel.replace("\\", "/")
+            out.append(Violation(
+                rule=self.name,
+                path=src.rel,
+                line=node.lineno,
+                message=(
+                    f"pallas_call in {where}, which "
+                    "mpi4dl_tpu/ops/kernel_registry.py does not import — "
+                    "the kernel is invisible to the pallascheck gate; "
+                    "register a KernelCase (or pragma a benchmark "
+                    "throwaway with a reason)"
+                ),
+            ))
+        return out
+
+
+RULE = UnregisteredPallasCallRule()

@@ -1,11 +1,14 @@
-"""SARIF 2.1.0 output for analyzer violations and ircheck findings.
+"""SARIF 2.1.0 output for analyzer violations and ircheck/pallascheck
+findings.
 
-One shared serializer so both gates render as GitHub code-scanning
+One shared serializer so all gates render as GitHub code-scanning
 annotations from a single uploaded log (the ``github/codeql-action/
 upload-sarif`` step in CI): analyzer violations carry their real
 ``path:line``; ircheck findings are IR-level (no single source line), so
 they anchor on the engine-family registry — the file whose builds produced
-the verified artifacts — with the family/scope context in the message.
+the verified artifacts — with the family/scope context in the message;
+pallascheck findings likewise anchor on the kernel registry, the file
+whose rows enrolled the traced kernels.
 
 Kept dependency-free and minimal: tool driver + rule index + results, the
 subset GitHub ingests.  Schema: https://json.schemastore.org/sarif-2.1.0.
@@ -20,6 +23,10 @@ _SCHEMA = "https://json.schemastore.org/sarif-2.1.0.json"
 
 # Where IR-level findings (which have no one source line) anchor.
 IRCHECK_ANCHOR = "mpi4dl_tpu/analysis/contracts/engines.py"
+
+# Where kernel-level pallascheck findings anchor: the registry row is the
+# reviewable artifact that enrolled the kernel into the gate.
+PALLASCHECK_ANCHOR = "mpi4dl_tpu/ops/kernel_registry.py"
 
 
 def _result(rule_id: str, message: str, uri: str, line: int,
@@ -44,9 +51,10 @@ def _result(rule_id: str, message: str, uri: str, line: int,
 
 
 def sarif_log(violations: Sequence = (), ircheck_findings: Sequence = (),
+              pallas_findings: Sequence = (),
               rule_descriptions: Optional[Dict[str, str]] = None) -> dict:
-    """One SARIF log dict from analyzer ``Violation``s and/or ircheck
-    ``Finding``s."""
+    """One SARIF log dict from analyzer ``Violation``s, ircheck
+    ``Finding``s and/or pallascheck ``Finding``s."""
     rule_index: Dict[str, int] = {}
     results: List[dict] = []
     for v in violations:
@@ -57,6 +65,13 @@ def sarif_log(violations: Sequence = (), ircheck_findings: Sequence = (),
         msg = f"[{where}] {f.message}" if where else f.message
         results.append(_result(
             f"ircheck/{f.kind}", msg, IRCHECK_ANCHOR, 1, rule_index,
+        ))
+    for f in pallas_findings:
+        where = " / ".join(p for p in (f.kernel, f.grid_class) if p)
+        msg = f"[{where}] {f.message}" if where else f.message
+        results.append(_result(
+            f"pallascheck/{f.kind}", msg, PALLASCHECK_ANCHOR, 1,
+            rule_index,
         ))
     descriptions = rule_descriptions or {}
     rules = [

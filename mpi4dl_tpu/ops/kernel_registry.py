@@ -1,0 +1,129 @@
+"""Pallas kernel registry — the enrollment point of the static verifier.
+
+Every hand-written Pallas kernel in ``mpi4dl_tpu/ops`` registers its public
+entry here as one or more :class:`KernelCase` rows: a representative trace
+(shapes chosen so every grid dimension has interior AND edge points) for
+each dtype/variant path the engines dispatch.  The verifier
+(``mpi4dl_tpu/analysis/pallascheck``) traces each case on CPU, extracts the
+``pallas_call`` specs from the jaxpr, and certifies grid/BlockSpec
+soundness, the per-grid-point VMEM total, DMA/semaphore discipline and
+accumulator-init coverage — see docs/analysis.md ("Pallas verifier").
+
+Two things key off this module being the single registry:
+
+- ``python -m mpi4dl_tpu.analysis pallascheck`` verifies exactly these
+  cases, so a new kernel (ROADMAP item 2's halo-RDMA conv) is enrolled by
+  adding a row — the gate covers it with no CI change;
+- AST rule 12 ``unregistered-pallas-call`` statically parses THIS file's
+  imports: a ``pl.pallas_call`` in any ``mpi4dl_tpu`` module not imported
+  here is a violation, so a kernel cannot ship unverified.
+
+Cases must trace with ``jax.make_jaxpr`` on a CPU host (no TPU compile, no
+real mesh); keep shapes small — the verifier enumerates the full grid.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Sequence, Tuple
+
+# Imports below double as rule-12 registration: a module whose kernels are
+# verified must be imported here (statically parsed, never executed by the
+# analyzer).
+from mpi4dl_tpu.ops.pallas_attention import block_flash
+from mpi4dl_tpu.ops.pallas_conv import halo_conv2d
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelCase:
+    """One verified trace of a registered kernel.
+
+    ``build()`` returns ``(fn, args)`` such that ``jax.make_jaxpr(fn)(*args)``
+    contains at least one ``pallas_call`` equation.  ``ring_size``, when
+    set, declares the remote-DMA neighbor topology the kernel's
+    ``make_async_remote_copy`` ``device_id`` map must be bijective against
+    (None = the kernel performs no remote copies; a remote copy in such a
+    case is itself a finding).
+    """
+
+    name: str
+    build: Callable[[], Tuple[Callable, tuple]]
+    ring_size: Optional[int] = None
+
+
+def _conv_case(dtype: str, fused: bool):
+    def build():
+        import jax.numpy as jnp
+
+        dt = jnp.dtype(dtype)
+        # Grid (th-tiles, 2, 3): every grid dim has an edge and the Cout
+        # dim an interior point; cout=300 exercises the lane-pad tail.
+        x = jnp.zeros((1, 130, 258, 8), dt)
+        w = jnp.zeros((3, 3, 8, 300), dt)
+        if fused:
+            # Margin-excluding stat window, as the D2 dispatch passes it.
+            fn = lambda x, w: halo_conv2d(  # noqa: E731
+                x, w, fuse_relu=True, stat_window=(1, 127, 2, 254)
+            )
+        else:
+            fn = halo_conv2d
+        return fn, (x, w)
+
+    variant = "fused_stats:" if fused else ""
+    return KernelCase(name=f"halo_conv2d:{variant}{dtype}", build=build)
+
+
+def _flash_case(dtype: str, causal: bool):
+    def build():
+        import jax.numpy as jnp
+
+        dt = jnp.dtype(dtype)
+        # Grid (2, 3, 3): batch·heads edge-only, q/k dims with interior
+        # points; Tk=300 exercises the padded-key masking tail.
+        q = jnp.zeros((2, 48, 64), dt)
+        k = jnp.zeros((2, 300, 64), dt)
+        v = jnp.zeros((2, 300, 64), dt)
+        z = jnp.zeros((), jnp.int32)
+        fn = lambda q, k, v: block_flash(  # noqa: E731
+            q, k, v, z, z, causal, 0.125, 16, 128, False
+        )
+        return fn, (q, k, v)
+
+    variant = "causal:" if causal else ""
+    return KernelCase(name=f"block_flash:{variant}{dtype}", build=build)
+
+
+# The raw (fp32) path and the bf16 compute path the mixed-precision/quant
+# engines dispatch (quant/kernels.py itself is pure jnp — no pallas_call,
+# which rule 12 verifies stays true).
+REGISTRY: Tuple[KernelCase, ...] = (
+    _conv_case("float32", fused=False),
+    _conv_case("bfloat16", fused=False),
+    _conv_case("float32", fused=True),
+    _conv_case("bfloat16", fused=True),
+    _flash_case("float32", causal=False),
+    _flash_case("bfloat16", causal=True),
+)
+
+
+def registry_case(name: str) -> KernelCase:
+    for case in REGISTRY:
+        if case.name == name:
+            return case
+    raise KeyError(
+        f"no registered kernel case {name!r}; have "
+        f"{[c.name for c in REGISTRY]}"
+    )
+
+
+def case_names(kernels: Optional[Sequence[str]] = None) -> Tuple[str, ...]:
+    """Registered case names, optionally filtered by kernel prefix (the
+    part before the first ``:``) or exact case name."""
+    names = tuple(c.name for c in REGISTRY)
+    if kernels is None:
+        return names
+    wanted = set(kernels)
+    out = tuple(
+        n for n in names if n in wanted or n.split(":", 1)[0] in wanted
+    )
+    return out

@@ -51,6 +51,11 @@ def _kernel(x_any, w_any, o_ref, xwin, wbuf, acc, sem, wsem,
     n_ci >= 3, verified against a pure-DMA addressing probe that was exact).
     Keeping Cin whole means every scratch buffer is written by exactly one
     DMA per (i, j) visit, waited before first read — no reuse, no race.
+    This is no longer only a comment: pallascheck's DMA-discipline pass
+    (analysis/pallascheck/interp.py) walks this kernel's jaxpr over the
+    full grid and fails the build on any read of a DMA destination before
+    its wait or write to a DMA source while the copy is in flight — the
+    exact hazard class the chunked revision hit on hardware.
 
     The window DMA is guarded on the first Cout tile: scratch persists
     across the (innermost) Cout grid dimension, so the same window serves
@@ -121,14 +126,24 @@ def _kernel_stats(x_any, w_any, o_ref, s_ref, sq_ref, xwin, wbuf, acc, sem,
     sq_ref[0, 0, :] = jnp.sum(yv * yv, axis=(0, 1))
 
 
-# Per-program VMEM budget for the input-window scratch (bytes); the H tile
-# halves until the full-Cin window fits, so deep layers (cin 1024-2048) run
-# instead of dying in an opaque Mosaic allocation error.
-_WINDOW_BUDGET = 6 * 1024 * 1024
-# Cap on the per-Cout-tile weight slab [kh, kw, Cin, tco] — beyond this the
-# kernel would not fit VMEM alongside the window; callers should fall back
-# to XLA's conv (Conv2d's dispatch checks pallas_conv_eligible).
-_WSLAB_CAP = 8 * 1024 * 1024
+# Per-core VMEM pool the kernel budgets against (~16 MiB on current TPUs;
+# see the Pallas guide).  The caps below are DERIVED splits of this pool —
+# not hand-maintained constants — and the static verifier
+# (analysis/pallascheck) re-derives the per-grid-point total from the traced
+# specs and certifies it against this same number, so the splits cannot
+# silently drift past what a core can hold.
+_VMEM_BYTES = 16 * 1024 * 1024
+# Input-window scratch share (3/8 = 6 MiB): the H tile halves until the
+# full-Cin window fits, so deep layers (cin 1024-2048) run instead of dying
+# in an opaque Mosaic allocation error.
+_WINDOW_BUDGET = (3 * _VMEM_BYTES) // 8
+# Weight-slab share (1/2 = 8 MiB) for the per-Cout-tile slab
+# [kh, kw, Cin, tco] — beyond this the kernel would not fit VMEM alongside
+# the window; callers should fall back to XLA's conv (Conv2d's dispatch
+# checks pallas_conv_eligible).  The remaining 1/8 of the pool plus
+# whatever the shrink loops free covers the fp32 accumulator and the
+# double-buffered output block — bounded by _vmem_total_bytes below.
+_WSLAB_CAP = _VMEM_BYTES // 2
 # Default Cout tile — shared by halo_conv2d, the eligibility gate, and
 # _bwd's fallback check so their slab math cannot drift apart.
 _DEFAULT_TCO = 128
@@ -159,6 +174,25 @@ def _win_bytes(c: int, kh: int, kw: int, th: int, tw: int, itemsize: int) -> int
     return (th + kh - 1) * _round_up(tw + kw - 1, 8) * _cpad(c) * itemsize
 
 
+def _vmem_total_bytes(cin: int, kh: int, kw: int, th: int, tw: int,
+                      tco: int, in_item: int, w_item: int,
+                      out_item: int) -> int:
+    """Per-grid-point VMEM of one program: input window + weight slab +
+    fp32 accumulator scratch + the double-buffered output block (the Pallas
+    pipeline keeps two output buffers in flight).  This is the model the
+    wrapper's shrink loop bounds by ``_VMEM_BYTES`` and pallascheck's VMEM
+    certification re-derives from the traced ``pallas_call`` specs — the
+    first full verifier run flagged the fp32-at-default-tiles config at
+    ~17.2 MiB (window 4.6 + slab 0.6 + acc 4 + 2x4 out), which the
+    window-only budget could not see."""
+    return (
+        _win_bytes(cin, kh, kw, th, tw, in_item)
+        + _wslab_bytes(cin, kh, kw, tco, w_item)
+        + th * tw * tco * 4
+        + 2 * th * tw * tco * out_item
+    )
+
+
 def pallas_conv_eligible(cin: int, cout: int | None = None, kh: int = 3,
                          kw: int = 3, tco: int = _DEFAULT_TCO,
                          itemsize: int = 2) -> bool:
@@ -171,10 +205,15 @@ def pallas_conv_eligible(cin: int, cout: int | None = None, kh: int = 3,
     - input window within ``_WINDOW_BUDGET`` at the SMALLEST H tile (th=1) —
       tall-kernel deep-Cin shapes (e.g. 7x1 at Cin ~4k) can pass the slab cap
       yet have no fitting window, which previously surfaced as an opaque
-      Mosaic allocation error instead of a clean lax.conv fallback."""
+      Mosaic allocation error instead of a clean lax.conv fallback;
+    - the TOTAL per-grid-point model (window + slab + accumulator +
+      double-buffered out block, ``_vmem_total_bytes``) within the VMEM
+      pool at th=1 — two under-cap pieces can still sum past the core."""
     ok = (
         _wslab_bytes(cin, kh, kw, tco, itemsize) <= _WSLAB_CAP
         and _win_bytes(cin, kh, kw, 1, _DEFAULT_TW, itemsize) <= _WINDOW_BUDGET
+        and _vmem_total_bytes(cin, kh, kw, 1, _DEFAULT_TW, tco, itemsize,
+                              itemsize, itemsize) <= _VMEM_BYTES
     )
     if cout is not None:
         ok = ok and pallas_conv_eligible(cout, None, kh, kw, tco, itemsize)
@@ -244,6 +283,26 @@ def halo_conv2d(
             f"minimum H tile (th={th}) for cin={cin} kh={kh} kw={kw} tw={tw} "
             f"exceeds the VMEM window budget {_WINDOW_BUDGET} B — use "
             f"lax.conv for this layer (pallas_conv_eligible gates dispatch)"
+        )
+    # Bound the TOTAL per-grid-point model, not just the window: the fp32
+    # accumulator and the double-buffered output block scale with th too,
+    # and at fp32 defaults (th=64, tw=tco=128) the sum exceeds the 16 MiB
+    # pool even though window and slab are each under their caps — the
+    # verifier's first full run surfaced exactly this (pallascheck
+    # vmem-overbudget; see _vmem_total_bytes).
+    out_item = jnp.dtype(out_dtype).itemsize
+    while th > 1 and _vmem_total_bytes(
+        cin, kh, kw, th, tw, tco, x.dtype.itemsize, w.dtype.itemsize,
+        out_item,
+    ) > _VMEM_BYTES:
+        th //= 2
+    if _vmem_total_bytes(cin, kh, kw, th, tw, tco, x.dtype.itemsize,
+                         w.dtype.itemsize, out_item) > _VMEM_BYTES:
+        raise ValueError(
+            f"pallas halo_conv2d: per-grid-point VMEM total at the minimum "
+            f"H tile (th={th}) for cin={cin} kh={kh} kw={kw} tw={tw} "
+            f"tco={tco} exceeds the {_VMEM_BYTES} B pool — use lax.conv "
+            f"for this layer (pallas_conv_eligible gates dispatch)"
         )
     cout_p = _round_up(cout, tco)
     h_p = _round_up(h, th)

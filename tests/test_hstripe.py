@@ -392,3 +392,47 @@ def test_hstripe_exact_stats_matches_pad_once_train(monkeypatch):
     monkeypatch.delenv("MPI4DL_HSTRIPE_EXACT")
     y_d = striped(x)
     assert not np.allclose(np.asarray(y_d), np.asarray(y_e), atol=1e-5)
+
+
+def _ulp_diff(a, b):
+    """Max bit-pattern distance between two fp32 arrays (the IEEE-754
+    total-order trick: reflect negatives so the int32 view is monotonic)."""
+    a = np.asarray(a, np.float32)
+    b = np.asarray(b, np.float32)
+    ia = a.view(np.int32).astype(np.int64)
+    ib = b.view(np.int32).astype(np.int64)
+    ia = np.where(ia < 0, np.int64(-0x80000000) - ia, ia)
+    ib = np.where(ib < 0, np.int64(-0x80000000) - ib, ib)
+    return int(np.abs(ia - ib).max())
+
+
+@pytest.mark.parametrize(
+    "h,w,ph,pw",
+    [
+        (19, 13, (1, 1), (1, 1)),  # ragged tail, SAME-style pads
+        (18, 11, (0, 0), (0, 0)),  # VALID, margin-carrying
+        (22, 9, (1, 2), (2, 0)),   # asymmetric pads, odd everything
+    ],
+)
+def test_hstripe_odd_tail_is_bitexact(monkeypatch, h, w, ph, pw):
+    """Odd-tail certification (pallascheck's differential satellite): with
+    striping forced and the output height NOT divisible by the stripe
+    height, the ragged (zero-padded) final stripe must reproduce the
+    un-striped conv to the BIT — each output row is the same VALID conv
+    over the same window, so any ULP of drift means the tail slicing read
+    or wrote a wrong row."""
+    monkeypatch.setattr(hc, "_PATCH_BUDGET", 4000)
+    k1, k2 = jax.random.split(jax.random.key(7))
+    x = jax.random.normal(k1, (2, h, w, 4))
+    wk = jax.random.normal(k2, (3, 3, 4, 6)) / 9
+
+    # replicate the stripe-height choice and require a ragged final stripe
+    oh = h + ph[0] + ph[1] - 2
+    stripes = hc._pick_stripes(oh, w + pw[0] + pw[1], 4, 3, 3, 4)
+    sh = -(-oh // stripes)
+    assert stripes > 1 and oh % sh != 0, (stripes, sh, oh)
+
+    y = hc.hstripe_conv2d(x, wk, ph, pw)
+    y_ref = _ref(x, wk, ph, pw)
+    assert y.shape == y_ref.shape
+    assert _ulp_diff(y, y_ref) == 0

@@ -396,3 +396,58 @@ def test_single_device_fused_dispatch_matches_plain():
     # the fused op is pinned by test_premargin_fused_triple_matches_unfused.
     np.testing.assert_allclose(float(m0["loss"]), float(m1["loss"]),
                                rtol=5e-3)
+
+
+def _scaled_ulp(got, ref):
+    """Max absolute error in units of the last place of the reference
+    array's magnitude (|err| / (2^-23 * max|ref|)) — the reassociation-
+    aware ULP metric: a plain per-element ULP diff explodes where fp32
+    accumulation orders cancel near zero, while this bounds the error the
+    way the accumulator actually commits it."""
+    got = np.asarray(got, np.float32)
+    ref = np.asarray(ref, np.float32)
+    scale = float(np.max(np.abs(ref)))
+    assert scale > 0
+    return float(np.max(np.abs(got - ref)) / (np.float32(2.0) ** -23 * scale))
+
+
+@pytest.mark.parametrize(
+    "h,w,th,tw",
+    [
+        (13, 27, 8, 16),  # ragged H and W tails (13 % 8, 27 % 16)
+        (17, 19, 16, 16), # one-past-tile H, ragged W
+        (9, 33, 8, 32),   # single ragged row / column
+    ],
+)
+def test_fused_odd_tail_ulp(h, w, th, tw):
+    """Odd-tail differential certification for the fused kernel: H/W not
+    divisible by the tile, so the last grid row/column computes into padded
+    garbage lanes that the caller slice must drop and the stat window must
+    never integrate.  Kernel (interpret) == XLA reference composition to a
+    few ULP on y, sum and sumsq."""
+    from mpi4dl_tpu.ops.pallas_conv import fused_relu_conv_bn_t
+
+    kh = kw = 3
+    cin, cout = 8, 16
+    win = (1, h - 1, 2, w - 2)
+    assert h % th != 0 or w % tw != 0
+    x = jax.random.normal(jax.random.key(2), (1, h + kh - 1, w + kw - 1, cin))
+    wk = jax.random.normal(jax.random.key(3), (kh, kw, cin, cout)) * 0.1
+
+    def ref(x, wk):
+        y = _ref_conv(jax.nn.relu(x), wk)
+        yw = y[:, win[0]:win[1], win[2]:win[3], :].astype(jnp.float32)
+        return y, jnp.sum(yw, (0, 1, 2)), jnp.sum(yw * yw, (0, 1, 2))
+
+    want = ref(x, wk)
+    # the explicit-tile path (what a tuned caller gets: grid > 1 with a
+    # ragged final tile in both H and W)
+    got = halo_conv2d(x, wk, th=th, tw=tw, tco=16, fuse_relu=True,
+                      stat_window=win, interpret=True)
+    # and the public entry (default tiles: the whole image is one padded
+    # tile — the other odd-tail regime)
+    got_pub = fused_relu_conv_bn_t(x, wk, win, True)
+    for name, g, gp, r in zip(("y", "sum", "sumsq"), got, got_pub, want):
+        assert g.shape == r.shape == gp.shape
+        assert _scaled_ulp(g, r) <= 8.0, name
+        assert _scaled_ulp(gp, r) <= 8.0, name
